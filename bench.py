@@ -1,0 +1,78 @@
+"""Benchmark harness — runs on the real TPU chip.
+
+Times the full jitted training step (fwd+bwd+optimizer) of a ~330M-param
+dense decoder LM in bfloat16 and prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference (view-sonic/Cloud-Server @ v0) publishes no numbers
+(BASELINE.md: empty working tree), so vs_baseline is reported as 1.0 by
+definition against an empty baseline; the absolute tokens/sec and MFU are
+the numbers that matter round-over-round.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from cloud_server_tpu.config import MeshConfig, ModelConfig, TrainConfig
+    from cloud_server_tpu.parallel.mesh import make_mesh
+    from cloud_server_tpu.training import init_train_state, make_train_step
+
+    model_cfg = ModelConfig(
+        vocab_size=32000, embed_dim=1024, num_layers=16, num_heads=16,
+        num_kv_heads=16, head_dim=64, mlp_dim=4096, max_seq_len=1024,
+        dtype="bfloat16", param_dtype="float32", remat="full")
+    batch, seq = 8, 1024
+    train_cfg = TrainConfig(batch_size=batch, seq_len=seq, warmup_steps=10,
+                            total_steps=100)
+
+    mesh = make_mesh(MeshConfig())  # single chip
+    state = init_train_state(model_cfg, train_cfg, mesh, jax.random.key(0))
+    step, batch_sharding = make_train_step(model_cfg, train_cfg, mesh)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                           model_cfg.vocab_size), batch_sharding)
+    data = {"tokens": tokens}
+
+    # Warmup / compile.
+    for _ in range(3):
+        state, metrics = step(state, data)
+    jax.block_until_ready(metrics["loss"])
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, data)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * n_steps / dt
+
+    # Rough MFU: 6 * non-embedding params * tokens for fwd+bwd, vs 197
+    # TFLOP/s bf16 peak (TPU v5e).
+    n_layer_params = model_cfg.num_layers * (
+        4 * model_cfg.embed_dim * model_cfg.num_heads * model_cfg.head_dim
+        + 3 * model_cfg.embed_dim * model_cfg.mlp_dim)
+    n_embed = 2 * model_cfg.vocab_size * model_cfg.embed_dim
+    flops_per_token = 6 * (n_layer_params + n_embed)
+    mfu = flops_per_token * tokens_per_sec / 197e12
+
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_330M_bf16",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+        "extra": {"step_time_ms": round(1000 * dt / n_steps, 2),
+                  "approx_mfu": round(mfu, 4),
+                  "device": str(jax.devices()[0])},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""cloud_server_tpu — a TPU-native training & serving framework.
+
+Built from scratch for TPU (JAX/XLA/pallas/pjit). The reference repository
+(view-sonic/Cloud-Server @ v0) is an empty working tree (see SURVEY.md),
+so the capability set comes from the round-1 driver re-scope recorded in
+SURVEY.md §2b / §7.
+
+Design principles:
+  * Pure-functional models: parameters are plain pytrees, forward passes are
+    pure functions — everything composes with jit/grad/scan/shard_map.
+  * SPMD over a named `jax.sharding.Mesh` with canonical axes
+    (dp, fsdp, pp, tp, sp, ep); XLA inserts the collectives.
+  * Scan-over-layers with rematerialisation for compile speed and memory.
+  * bfloat16 activations on the MXU, float32 master params/optimizer state.
+"""
+
+__version__ = "0.1.0"
+
+from cloud_server_tpu.config import (  # noqa: F401
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)

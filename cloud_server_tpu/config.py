@@ -1,0 +1,118 @@
+"""Typed configuration system.
+
+Plain frozen dataclasses so configs are hashable (usable as jit static
+arguments) and serialise cleanly to/from JSON for checkpoint metadata.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Mapping
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Decoder-only transformer family configuration."""
+
+    vocab_size: int = 32000
+    embed_dim: int = 2048
+    num_layers: int = 16
+    num_heads: int = 16
+    num_kv_heads: int = 16  # < num_heads => grouped-query attention
+    head_dim: int = 128
+    mlp_dim: int = 8192
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    tie_embeddings: bool = False
+    # numerics
+    dtype: str = "bfloat16"  # activation/compute dtype
+    param_dtype: str = "float32"  # master parameter dtype
+    # attention implementation: "xla" | "flash" | "ring"
+    attention_impl: str = "xla"
+    # mixture of experts (0 experts => dense MLP)
+    num_experts: int = 0
+    num_experts_per_token: int = 2
+    expert_capacity_factor: float = 1.25
+    # rematerialisation policy for the layer scan: "none" | "full" | "dots"
+    remat: str = "full"
+    logits_softcap: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.num_heads % max(self.num_kv_heads, 1) != 0:
+            raise ValueError(
+                f"num_heads={self.num_heads} must be a multiple of "
+                f"num_kv_heads={self.num_kv_heads}"
+            )
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh. Axis sizes of 1 are always legal.
+
+    Canonical axis order (outer→inner, DCN-friendly outer, ICI-friendly
+    inner): dp, pp, fsdp, ep, sp, tp. Tensor parallelism is innermost so its
+    collectives ride the fastest ICI links.
+    """
+
+    dp: int = 1
+    pp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    AXIS_ORDER = ("dp", "pp", "fsdp", "ep", "sp", "tp")
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for a in self.AXIS_ORDER:
+            n *= getattr(self, a)
+        return n
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in self.AXIS_ORDER}
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 1000
+    weight_decay: float = 0.1
+    beta1: float = 0.9
+    beta2: float = 0.95
+    grad_clip_norm: float = 1.0
+    batch_size: int = 8  # global batch, in sequences
+    microbatch_steps: int = 1  # gradient accumulation factor
+    seq_len: int = 2048
+    z_loss_coef: float = 0.0
+    seed: int = 0
+    moe_aux_loss_coef: float = 0.01
+    moe_router_z_coef: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class InferConfig:
+    max_decode_len: int = 256
+    temperature: float = 1.0
+    top_k: int = 0  # 0 => disabled
+    top_p: float = 1.0  # 1.0 => disabled
+    eos_token_id: int = -1  # -1 => never stop early
+    pad_token_id: int = 0
+
+
+def to_json(cfg: Any) -> str:
+    return json.dumps(dataclasses.asdict(cfg), sort_keys=True)
+
+
+def from_json(cls: type, payload: str | Mapping[str, Any]):
+    data = json.loads(payload) if isinstance(payload, str) else dict(payload)
+    fields = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in data.items() if k in fields})

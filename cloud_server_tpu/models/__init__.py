@@ -1,0 +1,1 @@
+from cloud_server_tpu.models import transformer  # noqa: F401

@@ -1,0 +1,233 @@
+"""Flagship dense decoder-only LM (LLaMA-style: RMSNorm, RoPE, GQA, SwiGLU).
+
+Pure-functional: parameters are a plain dict pytree; `forward` is a pure
+function. Layers are *stacked* (leading layer axis on every block parameter)
+and executed with `lax.scan`, which keeps compile time O(1) in depth and
+lets us apply one remat policy per layer. All heavy math is expressed as
+einsums over bfloat16 activations so XLA tiles it onto the MXU.
+
+Logical sharding axes are declared next to each parameter in
+`param_logical_axes`; the actual mesh layout comes from
+`parallel.sharding.DEFAULT_RULES`.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cloud_server_tpu.config import ModelConfig
+from cloud_server_tpu.ops import apply_rope, causal_attention, rms_norm, rope_frequencies, swiglu
+
+Params = dict
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+def param_shapes(cfg: ModelConfig) -> dict[str, Any]:
+    L, D, H, KH, Dh, F, V = (cfg.num_layers, cfg.embed_dim, cfg.num_heads,
+                             cfg.num_kv_heads, cfg.head_dim, cfg.mlp_dim,
+                             cfg.vocab_size)
+    shapes = {
+        "embed": {"tokens": (V, D)},
+        "layers": {
+            "attn_norm": (L, D),
+            "mlp_norm": (L, D),
+            "wq": (L, D, H, Dh),
+            "wk": (L, D, KH, Dh),
+            "wv": (L, D, KH, Dh),
+            "wo": (L, H, Dh, D),
+            "w_gate": (L, D, F),
+            "w_up": (L, D, F),
+            "w_down": (L, F, D),
+        },
+        "final_norm": {"scale": (D,)},
+    }
+    if not cfg.tie_embeddings:
+        shapes["lm_head"] = {"kernel": (D, V)}
+    return shapes
+
+
+def param_logical_axes(cfg: ModelConfig) -> dict[str, Any]:
+    """Same structure as params; leaves are tuples of logical axis names."""
+    axes = {
+        "embed": {"tokens": ("vocab", "embed")},
+        "layers": {
+            "attn_norm": ("layers", "norm"),
+            "mlp_norm": ("layers", "norm"),
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": {"scale": ("norm",)},
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = {"kernel": ("embed", "vocab")}
+    return axes
+
+
+def _fan_in(name: str, cfg: ModelConfig) -> int:
+    D, H, KH, Dh, F = (cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads,
+                       cfg.head_dim, cfg.mlp_dim)
+    table = {"tokens": D, "kernel": D, "wq": D, "wk": D, "wv": D,
+             "wo": H * Dh, "w_gate": D, "w_up": D, "w_down": F}
+    return table[name]
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
+    """Truncated-normal init, std 1/sqrt(fan_in); norm scales init to 1."""
+    dtype = jnp.dtype(cfg.param_dtype)
+    shapes = param_shapes(cfg)
+    paths, treedef = jax.tree_util.tree_flatten_with_path(
+        shapes, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(rng, len(paths))
+
+    out = []
+    for (path, shape), key in zip(paths, keys):
+        name = path[-1].key
+        path_str = "/".join(p.key for p in path)
+        if "norm" in path_str:
+            out.append(jnp.ones(shape, dtype))
+        else:
+            std = 1.0 / math.sqrt(_fan_in(name, cfg))
+            out.append(
+                (jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32)
+                 * std).astype(dtype))
+    return jax.tree.unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _attention_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn):
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    o = attn_fn(q, k, v)
+    return x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+
+
+def _mlp_block(x, lp, cfg: ModelConfig):
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(cfg.dtype))
+    up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(cfg.dtype))
+    return x + jnp.einsum("bsf,fd->bsd", swiglu(gate, up),
+                          lp["w_down"].astype(cfg.dtype))
+
+
+def _block(x, layer_params, cfg: ModelConfig, cos, sin, attn_fn):
+    x = _attention_block(x, layer_params, cfg, cos, sin, attn_fn)
+    x = _mlp_block(x, layer_params, cfg)
+    return x
+
+
+def _get_attention_fn(cfg: ModelConfig):
+    if cfg.attention_impl == "xla":
+        return causal_attention
+    if cfg.attention_impl == "flash":
+        from cloud_server_tpu.ops.flash_attention import flash_attention
+        return flash_attention
+    if cfg.attention_impl == "ring":
+        from cloud_server_tpu.parallel.mesh import current_mesh
+        from cloud_server_tpu.parallel.ring_attention import (
+            ring_attention_sharded)
+
+        mesh = current_mesh()
+
+        def ring_fn(q, k, v):
+            return ring_attention_sharded(q, k, v, mesh)
+
+        return ring_fn
+    raise ValueError(f"unknown attention_impl: {cfg.attention_impl!r}")
+
+
+def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Full-sequence forward pass: (B, S) int32 -> (B, S, V) float32 logits."""
+    cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1], cfg.rope_theta)
+    x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
+    attn_fn = _get_attention_fn(cfg)
+
+    block = partial(_block, cfg=cfg, cos=cos, sin=sin, attn_fn=attn_fn)
+    if cfg.remat == "full":
+        block = jax.checkpoint(block)
+    elif cfg.remat == "dots":
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+    def scan_body(carry, layer_params):
+        return block(carry, layer_params), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+
+    head = (params["embed"]["tokens"].T if cfg.tie_embeddings
+            else params["lm_head"]["kernel"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return apply_logits_softcap(logits, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Loss
+# ---------------------------------------------------------------------------
+
+def apply_logits_softcap(logits: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    if cfg.logits_softcap > 0:
+        return cfg.logits_softcap * jnp.tanh(logits / cfg.logits_softcap)
+    return logits
+
+
+def masked_cross_entropy(logits: jnp.ndarray, batch: dict,
+                         z_loss_coef: float = 0.0):
+    """Shared next-token CE over full-S logits.
+
+    logits: (B, S, V) f32 for the full sequence (the last position is
+    dropped here); batch: {"tokens": (B, S), optional "mask": (B, S)}.
+    Returns (loss, metrics).
+    """
+    tokens = batch["tokens"]
+    logits = logits[:, :-1]
+    targets = tokens[:, 1:]
+    mask = batch.get("mask")
+    mask = jnp.ones(targets.shape, jnp.float32) if mask is None else (
+        mask[:, 1:].astype(jnp.float32))
+
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - target_logit
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / denom
+    metrics = {"loss": loss, "ppl_log": loss,
+               "accuracy": ((logits.argmax(-1) == targets) * mask).sum() / denom}
+    if z_loss_coef > 0.0:
+        z = (jnp.square(logz) * mask).sum() / denom
+        loss = loss + z_loss_coef * z
+        metrics["z_loss"] = z
+    return loss, metrics
+
+
+def next_token_loss(params: Params, batch: dict, cfg: ModelConfig,
+                    z_loss_coef: float = 0.0):
+    """Causal LM loss. batch: {"tokens": (B, S) int32, optional "mask": (B, S)}.
+
+    Predicts tokens[:, 1:] from tokens[:, :-1]. Forward runs on the full S
+    (not S-1) so the sequence stays divisible for sp-sharded attention; the
+    last position's logits are dropped inside `masked_cross_entropy`.
+    """
+    logits = forward(params, batch["tokens"], cfg)
+    return masked_cross_entropy(logits, batch, z_loss_coef)

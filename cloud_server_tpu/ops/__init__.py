@@ -1,0 +1,4 @@
+from cloud_server_tpu.ops.norms import rms_norm  # noqa: F401
+from cloud_server_tpu.ops.rope import apply_rope, rope_frequencies  # noqa: F401
+from cloud_server_tpu.ops.activations import swiglu  # noqa: F401
+from cloud_server_tpu.ops.attention import causal_attention  # noqa: F401

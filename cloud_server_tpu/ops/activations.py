@@ -1,0 +1,12 @@
+"""Gated activations."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def swiglu(gate: jnp.ndarray, up: jnp.ndarray) -> jnp.ndarray:
+    """SwiGLU gate: silu(gate) * up. Elementwise; XLA fuses it into the
+    surrounding matmuls so it never round-trips through HBM on its own."""
+    return jax.nn.silu(gate) * up

@@ -1,0 +1,76 @@
+"""Attention ops (XLA reference path).
+
+Grouped-query causal attention expressed as two large einsums so XLA can map
+them straight onto the MXU. Softmax runs in float32 (bfloat16 exp/sum loses
+mass at long context). The pallas flash kernel and the ring-attention
+sequence-parallel path share this module's conventions:
+
+  q: (B, S, H,  Dh)      k, v: (B, S, KH, Dh)      H = KH * q_per_kv
+
+and return (B, S, H, Dh).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def causal_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    kv_segment_start: int = 0,
+    q_positions: jnp.ndarray | None = None,
+    kv_length: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Causal grouped-query attention, dense XLA implementation.
+
+    Args:
+      q: (B, Sq, H, Dh).
+      k, v: (B, Skv, KH, Dh) with H a multiple of KH.
+      scale: qk scale; defaults to Dh ** -0.5.
+      kv_segment_start: absolute position of k[:, 0] (used by ring attention
+        where each shard holds a different sequence chunk).
+      q_positions: optional (B, Sq) absolute positions of the queries
+        (decode-time: a single position per sequence). Defaults to
+        arange(Sq) + kv_segment_start... i.e. aligned with the kv chunk.
+      kv_length: optional (B,) number of valid kv entries (decode-time
+        cache masking). Defaults to all valid.
+
+    Returns:
+      (B, Sq, H, Dh) in q.dtype.
+    """
+    b, sq, h, dh = q.shape
+    _, skv, kh, _ = k.shape
+    g = h // kh
+    if scale is None:
+        scale = dh**-0.5
+
+    qg = q.reshape(b, sq, kh, g, dh)
+    # (B, KH, G, Sq, Skv)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k, preferred_element_type=jnp.float32)
+    scores *= scale
+
+    if q_positions is None:
+        q_pos = (jnp.arange(sq) + kv_segment_start)[None, :]  # (1, Sq)
+    else:
+        q_pos = q_positions  # (B, Sq)
+    kv_pos = (jnp.arange(skv) + kv_segment_start)[None, :]  # (1, Skv)
+
+    causal = q_pos[:, :, None] >= kv_pos[:, None, :]  # (B|1, Sq, Skv)
+    if kv_length is not None:
+        valid = kv_pos < kv_length[:, None]  # (B, Skv)
+        causal = jnp.logical_and(causal, valid[:, None, :])
+    scores = jnp.where(causal[:, None, None, :, :], scores, NEG_INF)
+
+    probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum(
+        "bkgqs,bskd->bqkgd", probs.astype(v.dtype), v
+    )
+    return out.reshape(b, sq, h, dh)

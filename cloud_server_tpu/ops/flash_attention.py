@@ -1,0 +1,304 @@
+"""Pallas TPU flash attention (forward + backward).
+
+Blockwise causal attention with online softmax. The grid is
+(batch, q_heads, q_blocks, kv_blocks); the kv axis is innermost so the f32
+accumulators (o_acc, running max m, running sum l) live in VMEM scratch
+across kv iterations of one q block — TPU grids execute sequentially on a
+core, which is what makes carrying scratch across grid steps sound.
+
+GQA is handled in the index maps: kv blocks for q-head h come from kv-head
+h // (H // KH); no materialised repeat of k/v.
+
+The backward pass recomputes p blockwise (flash style) with a
+(batch, heads, kv_blocks, q_blocks) grid — kv-stationary so dk/dv accumulate
+in scratch; dq is accumulated into its output block across the inner q loop
+revisits... (dq uses q-stationary accumulation via a second kernel).
+
+On non-TPU backends (tests), `interpret=True` runs the same kernels through
+the pallas interpreter so numerics are verified on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _default_block(seq: int, want: int) -> int:
+    b = min(seq, want)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, block_q, block_kv,
+                kv_seq_len):
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Block-level causal skip: kv block strictly after the q block's end.
+    @pl.when(j * block_kv <= i * block_q + block_q - 1)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # (bq, d)
+        k = k_ref[0, 0].astype(jnp.float32)  # (bkv, d)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bkv)
+
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        kv_pos = j * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # (bq, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == pl.num_programs(3) - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_ref[:, :1] + jnp.log(l))[:, 0]
+
+
+def _fwd(q, k, v, *, scale, block_q, block_kv, interpret):
+    b, h, sq, d = q.shape
+    _, kh, skv, _ = k.shape
+    g = h // kh
+    grid = (b, h, pl.cdiv(sq, block_q), pl.cdiv(skv, block_kv))
+
+    kv_spec = pl.BlockSpec((1, 1, block_kv, d),
+                           lambda bi, hi, i, j: (bi, hi // g, j, 0))
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
+                          block_kv=block_kv, kv_seq_len=skv),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, i, j: (bi, hi, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem((block_q, d), jnp.float32),
+            _vmem((block_q, 128), jnp.float32),
+            _vmem((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _vmem(shape, dtype):
+    return pltpu.VMEM(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (flash-style recompute)
+# ---------------------------------------------------------------------------
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
+                     dk_ref, dv_ref, dk_acc, dv_acc, *,
+                     scale, block_q, block_kv):
+    j, i = pl.program_id(2), pl.program_id(3)  # kv-stationary: q innermost
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(i * block_q + block_q - 1 >= j * block_kv)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]  # (bq, 1)
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        kv_pos = j * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        mask = q_pos >= kv_pos
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bkv)
+
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)  # (bq, 1)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(i == pl.num_programs(3) - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
+                   dq_ref, dq_acc, *, scale, block_q, block_kv):
+    i, j = pl.program_id(2), pl.program_id(3)  # q-stationary: kv innermost
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(j * block_kv <= i * block_q + block_q - 1)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        kv_pos = j * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        p = jnp.where(q_pos >= kv_pos, jnp.exp(s - lse), 0.0)
+
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(3) - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, block_q, block_kv, interpret):
+    out, _ = _fwd(q, k, v, scale=scale, block_q=block_q, block_kv=block_kv,
+                  interpret=interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, block_q, block_kv, interpret):
+    out, lse = _fwd(q, k, v, scale=scale, block_q=block_q,
+                    block_kv=block_kv, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
+    q, k, v, out, lse = res
+    b, h, sq, d = q.shape
+    _, kh, skv, _ = k.shape
+    g = h // kh
+
+    nq, nkv = pl.cdiv(sq, block_q), pl.cdiv(skv, block_kv)
+
+    q_spec_qs = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0))
+    kv_spec_qs = pl.BlockSpec((1, 1, block_kv, d),
+                              lambda bi, hi, i, j: (bi, hi // g, j, 0))
+    lse_spec_qs = pl.BlockSpec((1, 1, block_q), lambda bi, hi, i, j: (bi, hi, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
+                          block_kv=block_kv),
+        grid=(b, h, nq, nkv),
+        in_specs=[q_spec_qs, kv_spec_qs, kv_spec_qs, q_spec_qs, lse_spec_qs,
+                  q_spec_qs],
+        out_specs=q_spec_qs,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[_vmem((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, out, lse, do)
+
+    # kv-stationary grid for dk/dv: one pass per (kv block), q innermost.
+    # Outputs are per *q-head*; sum over the group afterwards for GQA.
+    q_spec_ks = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, j, i: (bi, hi, i, 0))
+    kv_spec_ks = pl.BlockSpec((1, 1, block_kv, d),
+                              lambda bi, hi, j, i: (bi, hi // g, j, 0))
+    lse_spec_ks = pl.BlockSpec((1, 1, block_q), lambda bi, hi, j, i: (bi, hi, i))
+    dkv_out_spec = pl.BlockSpec((1, 1, block_kv, d),
+                                lambda bi, hi, j, i: (bi, hi, j, 0))
+
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, scale=scale, block_q=block_q,
+                          block_kv=block_kv),
+        grid=(b, h, nkv, nq),
+        in_specs=[q_spec_ks, kv_spec_ks, kv_spec_ks, q_spec_ks, lse_spec_ks,
+                  q_spec_ks],
+        out_specs=[dkv_out_spec, dkv_out_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32)],
+        scratch_shapes=[_vmem((block_kv, d), jnp.float32),
+                        _vmem((block_kv, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, out, lse, do)
+
+    dk = dk_h.reshape(b, kh, g, skv, d).sum(axis=2).astype(k.dtype)
+    dv = dv_h.reshape(b, kh, g, skv, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, scale=None, block_q: int = 512,
+                    block_kv: int = 512, interpret: bool | None = None):
+    """Causal flash attention, (B, S, H, Dh) layout like ops.attention.
+
+    q: (B, S, H, Dh); k, v: (B, S, KH, Dh). Returns (B, S, H, Dh).
+    """
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = _default_block(sq, block_q)
+    block_kv = _default_block(k.shape[1], block_kv)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash_bhsd(qt, kt, vt, scale, block_q, block_kv, interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,27 @@
+"""Normalisation ops.
+
+RMSNorm is computed in float32 regardless of the activation dtype — the
+mean-of-squares reduction underflows in bfloat16 — and cast back afterwards.
+XLA fuses the whole thing into neighbouring ops, so there is no bandwidth
+cost to the upcast.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """Root-mean-square layer norm: x * scale / rms(x).
+
+    Args:
+      x: (..., d) activations, any float dtype.
+      scale: (d,) learned gain.
+      eps: numerical floor inside the rsqrt.
+    """
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    return out.astype(orig_dtype)

@@ -1,0 +1,49 @@
+"""Rotary position embeddings.
+
+Frequencies are computed once per forward pass in float32 (tiny — S x Dh/2)
+and the rotation is applied in float32 then cast back, because bfloat16
+phase error compounds visibly at long context.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int, max_seq_len: int, theta: float = 10000.0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Return (cos, sin), each (max_seq_len, head_dim // 2), float32."""
+    half = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    pos = jnp.arange(max_seq_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, inv_freq)  # (S, half)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Rotate q or k.
+
+    Args:
+      x: (B, S, H, Dh).
+      cos/sin: (max_seq_len, Dh//2) tables from `rope_frequencies`.
+      positions: optional (B, S) int32 absolute positions; defaults to
+        arange(S). Needed for decode where S=1 but the position is not 0.
+    """
+    b, s, _, head_dim = x.shape
+    half = head_dim // 2
+    if positions is None:
+        c = cos[:s][None, :, None, :]  # (1, S, 1, half)
+        sn = sin[:s][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]  # (B, S, 1, half)
+        sn = sin[positions][:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate([x1 * c - x2 * sn, x2 * c + x1 * sn], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,6 @@
+from cloud_server_tpu.parallel.mesh import make_mesh  # noqa: F401
+from cloud_server_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules,
+    DEFAULT_RULES,
+    logical_to_sharding,
+)

@@ -1,0 +1,58 @@
+"""Collective-communication layer.
+
+The framework's "communication backend" is XLA's collective set over
+ICI/DCN. Inside `jit` with sharded arrays, XLA inserts these automatically
+from sharding constraints; inside `shard_map` (ring attention, expert
+all-to-all, pipeline transfers) we call them explicitly. These wrappers are
+thin on purpose — they exist so the rest of the codebase names collectives
+in one place, and so a future pallas DMA-based implementation can swap in
+underneath without touching call sites.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def psum(x, axis: str):
+    return lax.psum(x, axis_name=axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis_name=axis)
+
+
+def all_gather(x, axis: str, *, tiled_axis: int = 0):
+    """Gather shards along a mesh axis into a full array (concatenated on
+    `tiled_axis`)."""
+    return lax.all_gather(x, axis_name=axis, axis=tiled_axis, tiled=True)
+
+
+def reduce_scatter(x, axis: str, *, scatter_axis: int = 0):
+    return lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_axis,
+                            tiled=True)
+
+
+def ppermute_shift(x, axis: str, shift: int = 1):
+    """Rotate shards around a mesh axis (the ring step of ring attention).
+
+    shift=+1 sends this device's value to the next device on the ring.
+    """
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int):
+    """MoE dispatch/combine primitive over the ep axis."""
+    return lax.all_to_all(x, axis_name=axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)

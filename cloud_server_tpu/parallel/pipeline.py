@@ -1,0 +1,156 @@
+"""Pipeline parallelism over the `pp` mesh axis.
+
+SPMD GPipe: the layer stack is split into `pp` stages (the stacked layer
+axis is sharded over the pp mesh axis, so each device holds L/pp layers).
+Under `shard_map`, every device runs the same program: at step t it applies
+its stage to the microbatch it holds, then `ppermute`s the activation to the
+next stage. After M + pp - 1 steps all M microbatches have flowed through;
+the last stage's collected outputs are broadcast with a masked psum.
+
+This is differentiable end-to-end (ppermute has a transpose rule: the
+reverse permutation), so the backward pass is the mirrored pipeline —
+no hand-written schedule, XLA sees one fused program per device.
+
+The bubble is the standard GPipe (pp - 1) / (M + pp - 1); raise
+`num_microbatches` to amortise it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cloud_server_tpu.models import transformer
+
+
+def pipeline_spmd(stage_params, microbatches, stage_fn: Callable,
+                  *, axis_name: str = "pp"):
+    """Run microbatches through the pipeline. Call under shard_map.
+
+    Args:
+      stage_params: this device's slice of the stacked layer params
+        (leading layer axis length L/pp locally).
+      microbatches: (M, mb, ...) replicated input microbatches.
+      stage_fn: (stage_params, x) -> y applying this stage's layers.
+      axis_name: the pipeline mesh axis.
+
+    Returns:
+      (M, mb, ...) outputs, replicated (valid on every device).
+    """
+    pp = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    t_total = m + pp - 1
+
+    def body(carry, t):
+        recv, outputs = carry
+        mb_idx = jnp.clip(t, 0, m - 1)
+        x_in = jnp.where(stage == 0, microbatches[mb_idx], recv)
+        y = stage_fn(stage_params, x_in)
+        out_idx = t - (pp - 1)
+        is_valid_out = jnp.logical_and(stage == pp - 1, out_idx >= 0)
+        outputs = lax.cond(
+            is_valid_out,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.clip(out_idx, 0, m - 1), axis=0),
+            lambda o: o,
+            outputs)
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        recv_next = lax.ppermute(y, axis_name, perm)
+        return (recv_next, outputs), None
+
+    recv0 = jnp.zeros_like(microbatches[0])
+    outputs0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(body, (recv0, outputs0), jnp.arange(t_total))
+
+    # Only the last stage holds real outputs; masked psum broadcasts them.
+    mask = (stage == pp - 1).astype(outputs.dtype)
+    return lax.psum(outputs * mask, axis_name)
+
+
+def make_pipelined_forward(model_cfg, mesh: Mesh, num_microbatches: int,
+                           rules=None):
+    """Return forward(params, tokens) running the block stack as a pipeline.
+
+    Embedding / final norm / head run replicated over pp (they are cheap
+    relative to the stack); only the L-layer block scan is pipelined.
+    """
+    from cloud_server_tpu.ops import rms_norm, rope_frequencies
+    from cloud_server_tpu.parallel.sharding import DEFAULT_RULES
+
+    rules = rules or DEFAULT_RULES
+    pp = mesh.shape["pp"]
+    if model_cfg.num_layers % pp:
+        raise ValueError(f"num_layers={model_cfg.num_layers} not divisible "
+                         f"by pp={pp}")
+
+    def stage_fn_factory(cos, sin, attn_fn):
+        def stage_fn(stage_params, x):
+            block = functools.partial(transformer._block, cfg=model_cfg,
+                                      cos=cos, sin=sin, attn_fn=attn_fn)
+            if model_cfg.remat == "full":
+                block = jax.checkpoint(block)
+            elif model_cfg.remat == "dots":
+                block = jax.checkpoint(
+                    block,
+                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+            def scan_body(h, lp):
+                return block(h, lp), None
+
+            out, _ = lax.scan(scan_body, x, stage_params)
+            return out
+        return stage_fn
+
+    layer_spec = P("pp")  # stacked layer axis sharded over pp
+    batch_spec = P(rules["batch"])
+
+    def forward(params, tokens):
+        cfg = model_cfg
+        cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1],
+                                    cfg.rope_theta)
+        x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]  # (B, S, D)
+        b = x.shape[0]
+        mb = b // num_microbatches
+        micro = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+        attn_fn = transformer._get_attention_fn(cfg)
+        stage_fn = stage_fn_factory(cos, sin, attn_fn)
+
+        pipe = jax.shard_map(
+            functools.partial(pipeline_spmd, stage_fn=stage_fn),
+            mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: layer_spec, params["layers"]),
+                      P(None, *batch_spec)),
+            out_specs=P(None, *batch_spec),
+            check_vma=False,
+        )
+        micro_out = pipe(params["layers"], micro)
+        x = micro_out.reshape(x.shape)
+
+        x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+        head = (params["embed"]["tokens"].T if cfg.tie_embeddings
+                else params["lm_head"]["kernel"])
+        logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        return transformer.apply_logits_softcap(logits, cfg)
+
+    return forward
+
+
+def make_pipelined_loss(model_cfg, mesh: Mesh, num_microbatches: int,
+                        z_loss_coef: float = 0.0):
+    """Pipelined replacement for transformer.next_token_loss; same signature
+    (params, batch, cfg) so it drops into make_train_step(loss_fn=...)."""
+    fwd = make_pipelined_forward(model_cfg, mesh, num_microbatches)
+
+    def loss_fn(params, batch, cfg):
+        logits = fwd(params, batch["tokens"])
+        return transformer.masked_cross_entropy(logits, batch, z_loss_coef)
+
+    return loss_fn

@@ -1,0 +1,117 @@
+"""Ring attention — causal sequence/context parallelism over the `sp` axis.
+
+Each device holds one contiguous chunk of the sequence (queries AND kv). The
+kv chunks rotate around the ring via `ppermute` while every device folds the
+visiting chunk into a running online-softmax accumulator (m, l, acc), so the
+full S x S attention is computed with S/n-sized live buffers and n-1 ICI
+hops. Communication overlaps compute under XLA's async collectives since
+the ppermute of step t+1 has no data dependency on the math of step t.
+
+Causality across chunks is handled with absolute positions: every chunk
+carries its origin index, so a visiting chunk that is entirely in this
+device's future contributes nothing (fully masked rows are explicitly
+zeroed — no NaNs from -inf softmax).
+
+Used inside `shard_map` (see `ring_attention_sharded`), or composed into
+the transformer via ModelConfig(attention_impl="ring").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _chunk_merge(carry, q, k, v, q_off, kv_off, scale):
+    """Fold one visiting kv chunk into the online-softmax accumulators.
+
+    carry: (acc (B,KH,G,Sq,Dh) f32, m (B,KH,G,Sq,1) f32, l same).
+    q: (B, Sq, H, Dh); k, v: (B, Skv, KH, Dh).
+    q_off / kv_off: absolute position of element 0 of each chunk (traced).
+    """
+    acc, m, l = carry
+    b, sq, h, dh = q.shape
+    _, skv, kh, _ = k.shape
+    g = h // kh
+
+    qg = q.reshape(b, sq, kh, g, dh)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
+                   preferred_element_type=jnp.float32) * scale
+
+    q_pos = q_off + jnp.arange(sq)
+    kv_pos = kv_off + jnp.arange(skv)
+    mask = (q_pos[:, None] >= kv_pos[None, :])[None, None, None]  # (1,1,1,Sq,Skv)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    # Explicitly zero masked entries: when a whole row is masked,
+    # exp(s - m_new) would be exp(0) = 1, not 0.
+    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * corr + jnp.einsum(
+        "bkgqs,bskd->bkgqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
+    return acc_new, m_new, l_new
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   *, axis_name: str = "sp", scale: float | None = None):
+    """Causal GQA over a sequence sharded on `axis_name`. Call under shard_map.
+
+    q: (B, Sq_local, H, Dh); k, v: (B, Skv_local, KH, Dh) — the local chunks.
+    Chunks are assumed laid out in ring order: device i holds positions
+    [i * Sq_local, (i+1) * Sq_local).
+
+    Returns the local output chunk (B, Sq_local, H, Dh).
+    """
+    b, sq, h, dh = q.shape
+    _, skv, kh, _ = k.shape
+    g = h // kh
+    if scale is None:
+        scale = dh**-0.5
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    q_off = idx * sq
+
+    acc = jnp.zeros((b, kh, g, sq, dh), jnp.float32)
+    m = jnp.full((b, kh, g, sq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, kh, g, sq, 1), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(t, state):
+        acc, m, l, kc, vc = state
+        src = (idx - t) % n  # who this kv chunk belongs to
+        acc, m, l = _chunk_merge((acc, m, l), q, kc, vc,
+                                 q_off, src * skv, scale)
+        kc, vc = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm), (kc, vc))
+        return acc, m, l, kc, vc
+
+    # n-1 fold+rotate steps, then a final fold with no wasted rotation.
+    acc, m, l, kc, vc = lax.fori_loop(0, n - 1, body, (acc, m, l, k, v))
+    acc, m, l = _chunk_merge((acc, m, l), q, kc, vc,
+                             q_off, ((idx - (n - 1)) % n) * skv, scale)
+    out = acc / jnp.maximum(l, 1e-30)  # (B, KH, G, Sq, Dh)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, h, dh).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, *, scale=None,
+                           batch_axes=("dp", "fsdp"), seq_axis="sp",
+                           head_axis="tp"):
+    """shard_map wrapper: full (B, S, H, Dh) arrays in, ring attention over
+    the sp axis, full arrays out (still sharded by the same specs)."""
+    qspec = P(batch_axes, seq_axis, head_axis, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, scale=scale)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
+        check_vma=False)(q, k, v)

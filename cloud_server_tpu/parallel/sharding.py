@@ -1,0 +1,64 @@
+"""Logical-axis sharding rules.
+
+Every parameter and activation is annotated with *logical* axis names
+("embed", "heads", "mlp", ...). A `ShardingRules` table maps logical names
+to mesh axes (or None for replicated). This is the single place where the
+parallelism layout of the whole framework is decided; models never mention
+mesh axes directly.
+
+The default table implements the standard megatron-style layout:
+  * tensor parallelism (tp) shards heads / mlp / vocab,
+  * fsdp shards the embed (weight-stationary) dimension of every matrix,
+  * batch is data-parallel over (dp, fsdp), sequence over sp (ring attn),
+  * experts over ep, pipeline stages over pp.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# logical axis name -> mesh axis (str), tuple of mesh axes, or None
+ShardingRules = Mapping[str, Any]
+
+DEFAULT_RULES: dict[str, Any] = {
+    "batch": ("dp", "fsdp"),
+    "sequence": "sp",
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "layers": None,
+    "stages": "pp",
+    "experts": "ep",
+    "expert_mlp": "tp",
+    "norm": None,
+}
+
+
+def spec_from_logical(logical_axes: Sequence[str | None],
+                      rules: ShardingRules = DEFAULT_RULES) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec."""
+    return P(*[rules[a] if a is not None else None for a in logical_axes])
+
+
+def logical_to_sharding(logical_tree: Any, mesh: Mesh,
+                        rules: ShardingRules = DEFAULT_RULES) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings.
+
+    Leaves of `logical_tree` are tuples/lists of logical axis names (or None
+    entries for replicated dims); structure must match the param pytree.
+    """
+    def leaf(axes):
+        return NamedSharding(mesh, spec_from_logical(axes, rules))
+
+    return jax.tree.map(
+        leaf, logical_tree,
+        is_leaf=lambda x: isinstance(x, (tuple, list)) and
+        all(isinstance(a, str) or a is None for a in x),
+    )

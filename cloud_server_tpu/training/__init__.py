@@ -1,0 +1,6 @@
+from cloud_server_tpu.training.optim import make_optimizer  # noqa: F401
+from cloud_server_tpu.training.train_step import (  # noqa: F401
+    TrainState,
+    init_train_state,
+    make_train_step,
+)

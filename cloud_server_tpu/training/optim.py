@@ -1,0 +1,45 @@
+"""Optimizer construction (optax).
+
+AdamW with linear warmup → cosine decay and global-norm clipping. Weight
+decay is masked off norm scales, matching standard LLM practice. Optimizer
+state inherits the parameters' sharding (same pytree structure), so FSDP
+shards moments for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+
+from cloud_server_tpu.config import TrainConfig
+
+
+def _decay_mask(params):
+    def is_decayed(path, _):
+        path_str = "/".join(p.key for p in path)
+        return "norm" not in path_str
+
+    return jax.tree_util.tree_map_with_path(is_decayed, params)
+
+
+def make_schedule(cfg: TrainConfig) -> optax.Schedule:
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=cfg.learning_rate,
+        warmup_steps=cfg.warmup_steps,
+        decay_steps=max(cfg.total_steps, cfg.warmup_steps + 1),
+        end_value=cfg.learning_rate * 0.1,
+    )
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip_norm),
+        optax.adamw(
+            learning_rate=make_schedule(cfg),
+            b1=cfg.beta1,
+            b2=cfg.beta2,
+            weight_decay=cfg.weight_decay,
+            mask=_decay_mask,
+        ),
+    )

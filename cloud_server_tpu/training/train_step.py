@@ -1,0 +1,150 @@
+"""Sharded training step.
+
+The whole step — forward, backward, optimizer update — is one `jit` with
+explicit in/out shardings. XLA derives every collective (gradient
+reduce-scatter/all-gather for FSDP, activation psums for TP) from the
+sharding annotations; there is no hand-written gradient sync.
+
+Gradient accumulation is a `lax.scan` over microbatches *inside* the jit,
+so accumulation never leaves the device.
+"""
+
+from __future__ import annotations
+
+import inspect
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import optax
+
+from cloud_server_tpu.config import ModelConfig, TrainConfig
+from cloud_server_tpu.models import transformer
+from cloud_server_tpu.parallel.sharding import (
+    DEFAULT_RULES, logical_to_sharding, spec_from_logical)
+from cloud_server_tpu.training.optim import make_optimizer
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+
+def state_shardings(model_cfg: ModelConfig, mesh: Mesh,
+                    rules=DEFAULT_RULES,
+                    loss_fn_module=transformer) -> TrainState:
+    """Build the TrainState sharding pytree by abstract-evaluating init."""
+    logical = loss_fn_module.param_logical_axes(model_cfg)
+    param_sh = logical_to_sharding(logical, mesh, rules)
+
+    # Optimizer state mirrors params; derive its sharding by matching
+    # structure: any leaf of opt_state with the same shape as a param gets
+    # the param's sharding, scalars are replicated.
+    opt = make_optimizer(TrainConfig())
+    params_shape = jax.eval_shape(
+        partial(loss_fn_module.init_params, model_cfg), jax.random.key(0))
+    opt_shape = jax.eval_shape(opt.init, params_shape)
+
+    flat_params, _ = jax.tree.flatten(params_shape)
+    flat_param_sh, _ = jax.tree.flatten(param_sh)
+    shape_to_sh = {}
+    for p, s in zip(flat_params, flat_param_sh):
+        shape_to_sh.setdefault((p.shape, p.dtype), s)
+    replicated = NamedSharding(mesh, P())
+
+    def opt_leaf_sharding(leaf):
+        return shape_to_sh.get((leaf.shape, leaf.dtype), replicated)
+
+    opt_sh = jax.tree.map(opt_leaf_sharding, opt_shape)
+    return TrainState(step=replicated, params=param_sh, opt_state=opt_sh)
+
+
+def init_train_state(model_cfg: ModelConfig, train_cfg: TrainConfig,
+                     mesh: Mesh, rng: jax.Array,
+                     loss_fn_module=transformer) -> TrainState:
+    """Initialise params + optimizer state *sharded* — each device only
+    materialises its own shard (init runs under jit with out_shardings)."""
+    shardings = state_shardings(model_cfg, mesh, loss_fn_module=loss_fn_module)
+    opt = make_optimizer(train_cfg)
+
+    def init_fn(rng):
+        params = loss_fn_module.init_params(model_cfg, rng)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt.init(params))
+
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def make_train_step(model_cfg: ModelConfig, train_cfg: TrainConfig,
+                    mesh: Mesh, rules=DEFAULT_RULES,
+                    loss_fn: Callable | None = None,
+                    loss_fn_module=transformer):
+    """Return a jitted (state, batch) -> (state, metrics) function.
+
+    batch: {"tokens": (B, S) int32} with B the *global* batch size;
+    arrays must be laid out with the returned `batch_sharding`.
+    """
+    if loss_fn is None:
+        kwargs = {"z_loss_coef": train_cfg.z_loss_coef}
+        sig = inspect.signature(loss_fn_module.next_token_loss).parameters
+        if "aux_loss_coef" in sig:
+            kwargs["aux_loss_coef"] = train_cfg.moe_aux_loss_coef
+        if "router_z_coef" in sig:
+            kwargs["router_z_coef"] = train_cfg.moe_router_z_coef
+        loss_fn = partial(loss_fn_module.next_token_loss, **kwargs)
+    opt = make_optimizer(train_cfg)
+    shardings = state_shardings(model_cfg, mesh, rules, loss_fn_module)
+    batch_spec = spec_from_logical(("batch", None), rules)
+    batch_sharding = NamedSharding(mesh, batch_spec)
+    replicated = NamedSharding(mesh, P())
+
+    def grads_one_microbatch(params, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch, model_cfg)
+        return grads, metrics
+
+    def step_fn(state: TrainState, batch: dict):
+        nsteps = train_cfg.microbatch_steps
+        if nsteps == 1:
+            grads, metrics = grads_one_microbatch(state.params, batch)
+        else:
+            # (B, ...) -> (nsteps, B // nsteps, ...); scan accumulates.
+            micro = jax.tree.map(
+                lambda x: x.reshape((nsteps, x.shape[0] // nsteps) + x.shape[1:]),
+                batch)
+
+            def body(acc, mb):
+                # Keep each microbatch sharded like the global batch.
+                mb = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(x, batch_sharding),
+                    mb)
+                g, m = grads_one_microbatch(state.params, mb)
+                return (jax.tree.map(jnp.add, acc[0], g),
+                        jax.tree.map(jnp.add, acc[1], m)), None
+
+            g0, m0 = grads_one_microbatch(
+                state.params, jax.tree.map(lambda x: x[0], micro))
+            (gsum, msum), _ = lax.scan(
+                body, (g0, m0), jax.tree.map(lambda x: x[1:], micro))
+            grads = jax.tree.map(lambda g: g / nsteps, gsum)
+            metrics = jax.tree.map(lambda m: m / nsteps, msum)
+
+        updates, new_opt = opt.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt)
+        return new_state, metrics
+
+    step = jax.jit(
+        step_fn,
+        in_shardings=(shardings, batch_sharding),
+        out_shardings=(shardings, replicated),
+        donate_argnums=(0,),
+    )
+    return step, batch_sharding

@@ -1,0 +1,7 @@
+#!/bin/bash
+# Test entry point. Tests run on a virtual 8-device CPU mesh; unsetting
+# PALLAS_AXON_POOL_IPS stops sitecustomize from dialing the TPU relay
+# (one relay session per python process wedges concurrent runs and is
+# pointless for CPU tests).
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest tests/ "${@:--x -q}"

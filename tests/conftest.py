@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+
+All parallelism tests (dp/fsdp/tp/sp/ep/pp) run against this virtual mesh;
+the real TPU is only used by bench.py.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)
+# This JAX build defaults matmuls to bf16-style passes even on CPU; tests
+# verify numerics, so force full f32 accumulation here (TPU prod path keeps
+# the default and runs bf16 on the MXU).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,116 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cloud_server_tpu.ops import (
+    apply_rope, causal_attention, rms_norm, rope_frequencies, swiglu)
+
+
+def test_rms_norm_matches_reference():
+    x = jax.random.normal(jax.random.key(0), (2, 5, 16))
+    scale = jax.random.normal(jax.random.key(1), (16,)) * 0.1 + 1.0
+    got = rms_norm(x, scale)
+    ref = x / np.sqrt(np.mean(np.square(np.asarray(x)), -1, keepdims=True) + 1e-6)
+    ref = ref * np.asarray(scale)
+    np.testing.assert_allclose(np.asarray(got), ref, atol=1e-5)
+
+
+def test_rms_norm_bf16_computes_in_f32():
+    x = (jnp.ones((1, 1, 1024)) * 300).astype(jnp.bfloat16)  # 300^2 overflows bf16 sum
+    out = rms_norm(x, jnp.ones((1024,)))
+    assert out.dtype == jnp.bfloat16
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))
+    np.testing.assert_allclose(np.asarray(out, np.float32), 1.0, rtol=0.02)
+
+
+def test_rope_preserves_norm_and_relative_phase():
+    q = jax.random.normal(jax.random.key(0), (1, 8, 2, 32))
+    cos, sin = rope_frequencies(32, 8)
+    r = apply_rope(q, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(r), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1), rtol=1e-5)
+    # position 0 is the identity rotation
+    np.testing.assert_allclose(np.asarray(r[:, 0]), np.asarray(q[:, 0]), atol=1e-6)
+
+
+def test_rope_with_explicit_positions_matches_default():
+    q = jax.random.normal(jax.random.key(1), (2, 6, 2, 16))
+    cos, sin = rope_frequencies(16, 32)
+    positions = jnp.broadcast_to(jnp.arange(6), (2, 6))
+    np.testing.assert_allclose(
+        np.asarray(apply_rope(q, cos, sin, positions)),
+        np.asarray(apply_rope(q, cos, sin)), atol=1e-6)
+
+
+def test_swiglu():
+    g = jnp.array([1.0, -1.0])
+    u = jnp.array([2.0, 2.0])
+    got = np.asarray(swiglu(g, u))
+    sil = np.asarray(g) / (1 + np.exp(-np.asarray(g)))
+    np.testing.assert_allclose(got, sil * np.asarray(u), rtol=1e-6)
+
+
+def _reference_attention(q, k, v, kv_segment_start=0):
+    b, sq, h, dh = q.shape
+    _, skv, kh, _ = k.shape
+    g = h // kh
+    k = np.repeat(np.asarray(k, np.float32), g, axis=2)
+    v = np.repeat(np.asarray(v, np.float32), g, axis=2)
+    q = np.asarray(q, np.float32)
+    scores = np.einsum("bqhd,bshd->bhqs", q, k) / np.sqrt(dh)
+    qpos = np.arange(sq)[:, None] + kv_segment_start
+    kpos = np.arange(skv)[None, :] + kv_segment_start
+    scores = np.where(qpos >= kpos, scores, -1e30)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqs,bshd->bqhd", p, v)
+
+
+def test_causal_attention_matches_reference():
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (2, 16, 4, 8))
+    k = jax.random.normal(jax.random.key(1), (2, 16, 4, 8))
+    v = jax.random.normal(jax.random.key(2), (2, 16, 4, 8))
+    np.testing.assert_allclose(
+        np.asarray(causal_attention(q, k, v)),
+        _reference_attention(q, k, v), atol=2e-5)
+
+
+def test_causal_attention_gqa():
+    q = jax.random.normal(jax.random.key(0), (1, 12, 8, 16))
+    k = jax.random.normal(jax.random.key(1), (1, 12, 2, 16))
+    v = jax.random.normal(jax.random.key(2), (1, 12, 2, 16))
+    np.testing.assert_allclose(
+        np.asarray(causal_attention(q, k, v)),
+        _reference_attention(q, k, v), atol=2e-5)
+
+
+def test_causal_attention_is_causal():
+    """Changing a future token must not change earlier outputs."""
+    q = jax.random.normal(jax.random.key(0), (1, 8, 2, 4))
+    k = jax.random.normal(jax.random.key(1), (1, 8, 2, 4))
+    v = jax.random.normal(jax.random.key(2), (1, 8, 2, 4))
+    base = causal_attention(q, k, v)
+    k2 = k.at[:, -1].set(100.0)
+    v2 = v.at[:, -1].set(-100.0)
+    pert = causal_attention(q, k2, v2)
+    np.testing.assert_allclose(np.asarray(base[:, :-1]),
+                               np.asarray(pert[:, :-1]), atol=1e-6)
+
+
+def test_decode_style_attention_with_kv_length():
+    """Single query at position p attends only to cache[:p+1]."""
+    skv = 16
+    q = jax.random.normal(jax.random.key(0), (1, 1, 2, 4))
+    k = jax.random.normal(jax.random.key(1), (1, skv, 2, 4))
+    v = jax.random.normal(jax.random.key(2), (1, skv, 2, 4))
+    p = 5
+    out = causal_attention(
+        q, k, v,
+        q_positions=jnp.array([[p]]),
+        kv_length=jnp.array([p + 1]))
+    ref = _reference_attention(
+        jnp.broadcast_to(q, (1, p + 1, 2, 4)), k[:, :p + 1], v[:, :p + 1])
+    np.testing.assert_allclose(np.asarray(out[0, 0]), ref[0, -1], atol=2e-5)
