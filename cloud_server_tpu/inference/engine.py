@@ -1,0 +1,173 @@
+"""Autoregressive inference engine: KV cache, prefill + decode, generate.
+
+TPU-first shape discipline: the cache is a static (L, B, max_len, KH, Dh)
+buffer; prefill fills the prompt region in one batched pass (full MXU
+utilisation), then decode steps run S=1 attention against the cache under a
+single `lax.scan` inside one jit — no per-token dispatch, no dynamic
+shapes, no host round-trips. Sampling (greedy/temp/top-k/top-p) happens
+on-device between steps; finished sequences keep "generating" pad tokens so
+shapes stay static (standard SPMD practice).
+
+Sharding: cache heads ride the same `tp` axis as attention weights; batch
+rides (dp, fsdp). `generate` is jit-compatible and can be wrapped with
+shardings by the serving layer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cloud_server_tpu.config import InferConfig, ModelConfig
+from cloud_server_tpu.inference.sampling import sample_logits
+from cloud_server_tpu.models import transformer
+from cloud_server_tpu.ops import apply_rope, causal_attention, rms_norm, rope_frequencies
+from cloud_server_tpu.ops.activations import swiglu
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # (L, B, max_len, KH, Dh)
+    v: jnp.ndarray  # (L, B, max_len, KH, Dh)
+    length: jnp.ndarray  # (B,) int32 — valid entries per sequence
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   length=jnp.zeros((batch,), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Prefill
+# ---------------------------------------------------------------------------
+
+def prefill(params, tokens: jnp.ndarray, cfg: ModelConfig,
+            cache: KVCache) -> tuple[jnp.ndarray, KVCache]:
+    """Run the prompt (B, P) through the model, populating cache[:, :, :P].
+
+    Returns (logits at the last prompt position (B, V) f32, cache).
+    """
+    b, p = tokens.shape
+    max_len = cache.k.shape[2]
+    cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
+    x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
+    # honour cfg.attention_impl (flash for long prompts); decode keeps the
+    # dense cache path since a single query can't use the blockwise kernel.
+    attn_fn = transformer._get_attention_fn(cfg)
+
+    def scan_body(carry, lp):
+        x = carry
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        o = attn_fn(q, k, v)
+        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+        x = transformer._mlp_block(x, lp, cfg)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    head = (params["embed"]["tokens"].T if cfg.tie_embeddings
+            else params["lm_head"]["kernel"])
+    logits = jnp.einsum("bd,dv->bv", x[:, -1], head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    logits = transformer.apply_logits_softcap(logits, cfg)
+
+    new_k = lax.dynamic_update_slice(cache.k, ks, (0, 0, 0, 0, 0))
+    new_v = lax.dynamic_update_slice(cache.v, vs, (0, 0, 0, 0, 0))
+    length = jnp.full((b,), p, jnp.int32)
+    return logits, KVCache(new_k, new_v, length)
+
+
+# ---------------------------------------------------------------------------
+# Decode
+# ---------------------------------------------------------------------------
+
+def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
+                cache: KVCache) -> tuple[jnp.ndarray, KVCache]:
+    """One decode step. token: (B,) int32 at position cache.length.
+
+    Assumes uniform position across the batch (cache.length[0]); ragged
+    batches left-pad prompts to equal length.
+    """
+    b = token.shape[0]
+    max_len = cache.k.shape[2]
+    pos = cache.length[0]
+    cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
+    positions = jnp.broadcast_to(pos, (b, 1))
+
+    x = params["embed"]["tokens"].astype(cfg.dtype)[token[:, None]]  # (B,1,D)
+
+    def scan_body(carry, layer):
+        x = carry
+        lp, k_cache, v_cache = layer
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        o = causal_attention(
+            q, k_cache, v_cache,
+            q_positions=positions,
+            kv_length=cache.length + 1)
+        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+        x = transformer._mlp_block(x, lp, cfg)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(
+        scan_body, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    head = (params["embed"]["tokens"].T if cfg.tie_embeddings
+            else params["lm_head"]["kernel"])
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    logits = transformer.apply_logits_softcap(logits, cfg)
+    return logits, KVCache(new_k, new_v, cache.length + 1)
+
+
+# ---------------------------------------------------------------------------
+# Generate
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("cfg", "infer_cfg", "max_len"))
+def generate(params, prompt: jnp.ndarray, rng: jax.Array, *,
+             cfg: ModelConfig, infer_cfg: InferConfig,
+             max_len: int | None = None) -> jnp.ndarray:
+    """Batched generation. prompt: (B, P) int32 (equal-length prompts).
+
+    Returns (B, max_decode_len) int32. Sequences that hit eos_token_id emit
+    pad_token_id afterwards.
+    """
+    b, p = prompt.shape
+    n_new = infer_cfg.max_decode_len
+    max_len = max_len or (p + n_new)
+    if max_len < p + n_new:
+        raise ValueError(
+            f"max_len={max_len} < prompt ({p}) + max_decode_len ({n_new}); "
+            "the cache would silently wrap")
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = prefill(params, prompt, cfg, cache)
+
+    def step(carry, rng_t):
+        logits, cache, done = carry
+        tok = sample_logits(logits, rng_t, infer_cfg)
+        tok = jnp.where(done, infer_cfg.pad_token_id, tok)
+        done = jnp.logical_or(done, tok == infer_cfg.eos_token_id)
+        logits, cache = decode_step(params, tok, cfg, cache)
+        return (logits, cache, done), tok
+
+    rngs = jax.random.split(rng, n_new)
+    done0 = jnp.zeros((b,), bool)
+    (_, _, _), tokens = lax.scan(step, (logits, cache, done0), rngs)
+    return tokens.T  # (B, n_new)

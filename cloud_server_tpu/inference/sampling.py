@@ -1,0 +1,45 @@
+"""Token sampling — fully jittable (static shapes, no host sync).
+
+top-k uses lax.top_k; top-p sorts once and masks the tail. Both reduce to
+greedy when disabled. Temperature 0 is treated as greedy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cloud_server_tpu.config import InferConfig
+
+NEG_INF = -1e30
+
+
+def _apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    vals, _ = lax.top_k(logits, k)
+    cutoff = vals[..., -1:]
+    return jnp.where(logits < cutoff, NEG_INF, logits)
+
+
+def _apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep the smallest prefix with cumulative prob >= p (always >= 1 token)
+    keep = cum - probs < p
+    cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits < cutoff, NEG_INF, logits)
+
+
+def sample_logits(logits: jnp.ndarray, rng: jax.Array,
+                  cfg: InferConfig) -> jnp.ndarray:
+    """logits: (B, V) f32 -> (B,) int32 sampled token ids."""
+    if cfg.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    x = logits / cfg.temperature
+    if cfg.top_k > 0:
+        x = _apply_top_k(x, cfg.top_k)
+    if cfg.top_p < 1.0:
+        x = _apply_top_p(x, cfg.top_p)
+    return jax.random.categorical(rng, x, axis=-1).astype(jnp.int32)

@@ -75,7 +75,8 @@ def top_k_routing(router_logits: jnp.ndarray, k: int, capacity: int):
     pos = pos_kt.reshape(k, t, e).transpose(1, 0, 2)  # (T, k, E)
     keep = keep_kt.reshape(k, t, e).transpose(1, 0, 2)
 
-    slot_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # (T,k,E,C)
+    slot_onehot = jax.nn.one_hot(
+        pos.astype(jnp.int32), capacity, dtype=jnp.float32)  # (T,k,E,C)
     slot_onehot *= keep[..., None]
     dispatch = slot_onehot.sum(axis=1)  # (T, E, C)
     combine = (slot_onehot * gate_vals[:, :, None, None]).sum(axis=1)

@@ -65,11 +65,12 @@ def state_shardings(model_cfg: ModelConfig, mesh: Mesh,
 
 
 def init_train_state(model_cfg: ModelConfig, train_cfg: TrainConfig,
-                     mesh: Mesh, rng: jax.Array,
+                     mesh: Mesh, rng: jax.Array, rules=DEFAULT_RULES,
                      loss_fn_module=transformer) -> TrainState:
     """Initialise params + optimizer state *sharded* — each device only
     materialises its own shard (init runs under jit with out_shardings)."""
-    shardings = state_shardings(model_cfg, mesh, loss_fn_module=loss_fn_module)
+    shardings = state_shardings(model_cfg, mesh, rules,
+                                loss_fn_module=loss_fn_module)
     opt = make_optimizer(train_cfg)
 
     def init_fn(rng):
