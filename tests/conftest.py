@@ -15,6 +15,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# A sitecustomize on this image may import jax and register the TPU plugin
+# before conftest runs, making the env vars above too late. The config
+# update still wins as long as no backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+
 jax.config.update("jax_threefry_partitionable", True)
 # This JAX build defaults matmuls to bf16-style passes even on CPU; tests
 # verify numerics, so force full f32 accumulation here (TPU prod path keeps
