@@ -8,6 +8,16 @@ shapes, no host round-trips. Sampling (greedy/temp/top-k/top-p) happens
 on-device between steps; finished sequences keep "generating" pad tokens so
 shapes stay static (standard SPMD practice).
 
+Ragged batches are first-class: right-pad prompts to a common length and
+pass `lengths` (B,) — prefill tracks per-sequence cache lengths, decode
+writes each sequence's k/v at its own position and masks attention to the
+valid cache region, and RoPE positions are per-sequence. (Causality means
+real tokens never attend to the trailing pads, so right-padding is exact.)
+
+The transformer math itself (qkv projection + rope, output projection, MLP,
+unembed) is imported from `models.transformer` — the engine owns only the
+cache plumbing, so inference can never drift numerically from training.
+
 Sharding: cache heads ride the same `tp` axis as attention weights; batch
 rides (dp, fsdp). `generate` is jit-compatible and can be wrapped with
 shardings by the serving layer.
@@ -25,8 +35,7 @@ from jax import lax
 from cloud_server_tpu.config import InferConfig, ModelConfig
 from cloud_server_tpu.inference.sampling import sample_logits
 from cloud_server_tpu.models import transformer
-from cloud_server_tpu.ops import apply_rope, causal_attention, rms_norm, rope_frequencies
-from cloud_server_tpu.ops.activations import swiglu
+from cloud_server_tpu.ops import causal_attention, rms_norm, rope_frequencies
 
 
 class KVCache(NamedTuple):
@@ -46,11 +55,16 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:
 # Prefill
 # ---------------------------------------------------------------------------
 
-def prefill(params, tokens: jnp.ndarray, cfg: ModelConfig,
-            cache: KVCache) -> tuple[jnp.ndarray, KVCache]:
+def prefill(params, tokens: jnp.ndarray, cfg: ModelConfig, cache: KVCache,
+            lengths: jnp.ndarray | None = None
+            ) -> tuple[jnp.ndarray, KVCache]:
     """Run the prompt (B, P) through the model, populating cache[:, :, :P].
 
-    Returns (logits at the last prompt position (B, V) f32, cache).
+    Args:
+      tokens: (B, P) int32, right-padded when ragged.
+      lengths: optional (B,) int32 valid prompt lengths (defaults to P).
+
+    Returns (logits at each sequence's last valid position (B, V) f32, cache).
     """
     b, p = tokens.shape
     max_len = cache.k.shape[2]
@@ -62,77 +76,68 @@ def prefill(params, tokens: jnp.ndarray, cfg: ModelConfig,
 
     def scan_body(carry, lp):
         x = carry
-        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin)
         o = attn_fn(q, k, v)
-        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
-        x = transformer._mlp_block(x, lp, cfg)
+        x = transformer.attention_out(x, o, lp, cfg)
+        x = transformer.mlp_block(x, lp, cfg)
         return x, (k, v)
 
     x, (ks, vs) = lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
-    head = (params["embed"]["tokens"].T if cfg.tie_embeddings
-            else params["lm_head"]["kernel"])
-    logits = jnp.einsum("bd,dv->bv", x[:, -1], head.astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
-    logits = transformer.apply_logits_softcap(logits, cfg)
+    if lengths is None:
+        lengths = jnp.full((b,), p, jnp.int32)
+        x_last = x[:, -1]
+    else:
+        x_last = x[jnp.arange(b), lengths - 1]
+    logits = transformer.unembed(x_last, params, cfg)
 
     new_k = lax.dynamic_update_slice(cache.k, ks, (0, 0, 0, 0, 0))
     new_v = lax.dynamic_update_slice(cache.v, vs, (0, 0, 0, 0, 0))
-    length = jnp.full((b,), p, jnp.int32)
-    return logits, KVCache(new_k, new_v, length)
+    return logits, KVCache(new_k, new_v, lengths)
 
 
 # ---------------------------------------------------------------------------
 # Decode
 # ---------------------------------------------------------------------------
 
+def _update_at(cache_layer: jnp.ndarray, new: jnp.ndarray,
+               pos: jnp.ndarray) -> jnp.ndarray:
+    """Write new (B, 1, KH, Dh) into cache_layer (B, max_len, KH, Dh) at
+    per-sequence position pos (B,)."""
+    return jax.vmap(
+        lambda c, u, s: lax.dynamic_update_slice(c, u, (s, 0, 0))
+    )(cache_layer, new, pos)
+
+
 def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
                 cache: KVCache) -> tuple[jnp.ndarray, KVCache]:
-    """One decode step. token: (B,) int32 at position cache.length.
-
-    Assumes uniform position across the batch (cache.length[0]); ragged
-    batches left-pad prompts to equal length.
-    """
-    b = token.shape[0]
+    """One decode step. token: (B,) int32; sequence i sits at position
+    cache.length[i] (per-sequence — ragged batches are handled exactly)."""
     max_len = cache.k.shape[2]
-    pos = cache.length[0]
+    pos = cache.length  # (B,)
     cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
-    positions = jnp.broadcast_to(pos, (b, 1))
+    positions = pos[:, None]  # (B, 1)
 
     x = params["embed"]["tokens"].astype(cfg.dtype)[token[:, None]]  # (B,1,D)
 
     def scan_body(carry, layer):
         x = carry
         lp, k_cache, v_cache = layer
-        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
-        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin, positions)
+        k_cache = _update_at(k_cache, k, pos)
+        v_cache = _update_at(v_cache, v, pos)
         o = causal_attention(
             q, k_cache, v_cache,
             q_positions=positions,
             kv_length=cache.length + 1)
-        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
-        x = transformer._mlp_block(x, lp, cfg)
+        x = transformer.attention_out(x, o, lp, cfg)
+        x = transformer.mlp_block(x, lp, cfg)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = lax.scan(
         scan_body, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
-    head = (params["embed"]["tokens"].T if cfg.tie_embeddings
-            else params["lm_head"]["kernel"])
-    logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
-    logits = transformer.apply_logits_softcap(logits, cfg)
+    logits = transformer.unembed(x[:, 0], params, cfg)
     return logits, KVCache(new_k, new_v, cache.length + 1)
 
 
@@ -143,11 +148,15 @@ def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
 @functools.partial(jax.jit, static_argnames=("cfg", "infer_cfg", "max_len"))
 def generate(params, prompt: jnp.ndarray, rng: jax.Array, *,
              cfg: ModelConfig, infer_cfg: InferConfig,
-             max_len: int | None = None) -> jnp.ndarray:
-    """Batched generation. prompt: (B, P) int32 (equal-length prompts).
+             max_len: int | None = None,
+             prompt_lengths: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Batched generation. prompt: (B, P) int32, right-padded when ragged
+    (pass prompt_lengths (B,) for the true lengths).
 
     Returns (B, max_decode_len) int32. Sequences that hit eos_token_id emit
-    pad_token_id afterwards.
+    pad_token_id afterwards. Runs exactly max_decode_len - 1 decode steps:
+    the first token is sampled from prefill logits and the last sampled
+    token is never fed back through the model.
     """
     b, p = prompt.shape
     n_new = infer_cfg.max_decode_len
@@ -157,7 +166,7 @@ def generate(params, prompt: jnp.ndarray, rng: jax.Array, *,
             f"max_len={max_len} < prompt ({p}) + max_decode_len ({n_new}); "
             "the cache would silently wrap")
     cache = init_cache(cfg, b, max_len)
-    logits, cache = prefill(params, prompt, cfg, cache)
+    logits, cache = prefill(params, prompt, cfg, cache, prompt_lengths)
 
     def step(carry, rng_t):
         logits, cache, done = carry
@@ -169,5 +178,9 @@ def generate(params, prompt: jnp.ndarray, rng: jax.Array, *,
 
     rngs = jax.random.split(rng, n_new)
     done0 = jnp.zeros((b,), bool)
-    (_, _, _), tokens = lax.scan(step, (logits, cache, done0), rngs)
+    (logits, _, done), tokens = lax.scan(
+        step, (logits, cache, done0), rngs[:-1])
+    last = sample_logits(logits, rngs[-1], infer_cfg)
+    last = jnp.where(done, infer_cfg.pad_token_id, last)
+    tokens = jnp.concatenate([tokens, last[None]], axis=0)
     return tokens.T  # (B, n_new)

@@ -25,8 +25,10 @@ def _apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    # keep the smallest prefix with cumulative prob >= p (always >= 1 token)
-    keep = cum - probs < p
+    # keep the smallest prefix with cumulative prob >= p; force the top
+    # token in so p <= 0 degrades to greedy-ish rather than masking
+    # everything (which would sample uniformly over the whole vocab).
+    keep = (cum - probs < p).at[..., 0].set(True)
     cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
                      keepdims=True)
     return jnp.where(logits < cutoff, NEG_INF, logits)

@@ -111,18 +111,31 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _attention_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn):
+def attention_qkv(x, lp, cfg: ModelConfig, cos, sin, positions=None):
+    """Pre-norm + q/k/v projection + rope. Single source of truth for the
+    attention input path — the inference engine's prefill/decode reuse this
+    so cached inference can never drift numerically from training."""
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
     k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
     v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
-    o = attn_fn(q, k, v)
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    return q, k, v
+
+
+def attention_out(x, o, lp, cfg: ModelConfig):
+    """Output projection + residual add (the attention block's second half)."""
     return x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
 
 
-def _mlp_block(x, lp, cfg: ModelConfig):
+def _attention_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn):
+    q, k, v = attention_qkv(x, lp, cfg, cos, sin)
+    o = attn_fn(q, k, v)
+    return attention_out(x, o, lp, cfg)
+
+
+def mlp_block(x, lp, cfg: ModelConfig):
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(cfg.dtype))
     up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(cfg.dtype))
@@ -130,9 +143,18 @@ def _mlp_block(x, lp, cfg: ModelConfig):
                           lp["w_down"].astype(cfg.dtype))
 
 
+def unembed(x, params: Params, cfg: ModelConfig) -> jnp.ndarray:
+    """Final-norm'd hidden states (..., D) -> softcapped f32 logits (..., V)."""
+    head = (params["embed"]["tokens"].T if cfg.tie_embeddings
+            else params["lm_head"]["kernel"])
+    logits = jnp.einsum("...d,dv->...v", x, head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return apply_logits_softcap(logits, cfg)
+
+
 def _block(x, layer_params, cfg: ModelConfig, cos, sin, attn_fn):
     x = _attention_block(x, layer_params, cfg, cos, sin, attn_fn)
-    x = _mlp_block(x, layer_params, cfg)
+    x = mlp_block(x, layer_params, cfg)
     return x
 
 
@@ -174,12 +196,7 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarra
 
     x, _ = lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
-
-    head = (params["embed"]["tokens"].T if cfg.tie_embeddings
-            else params["lm_head"]["kernel"])
-    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
-    return apply_logits_softcap(logits, cfg)
+    return unembed(x, params, cfg)
 
 
 # ---------------------------------------------------------------------------

@@ -88,6 +88,44 @@ def test_top_p_keeps_minimum_one_token():
     assert int(tok[0]) == 0
 
 
+def test_top_p_zero_degrades_to_top_token():
+    """top_p <= 0 must keep the argmax, not mask the entire vocab."""
+    logits = jnp.array([[10.0, 0.0, -10.0, -10.0]])
+    for p in (0.0, -1.0):
+        tok = sample_logits(logits, jax.random.key(0),
+                            InferConfig(temperature=1.0, top_p=p))
+        assert int(tok[0]) == 0
+
+
+def test_ragged_prefill_decode_matches_unpadded():
+    """Right-padded ragged batch must match each prompt run unpadded."""
+    params = _params()
+    lens = [3, 6]
+    p = max(lens)
+    tokens = jax.random.randint(jax.random.key(7), (2, p), 1, TINY.vocab_size)
+    lengths = jnp.array(lens, jnp.int32)
+    padded = tokens * (jnp.arange(p)[None, :] < lengths[:, None])
+
+    cache = init_cache(TINY, 2, 16)
+    logits, cache = prefill(params, padded, TINY, cache, lengths)
+    # decode 4 greedy steps on the ragged batch
+    ragged_out = []
+    for _ in range(4):
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        ragged_out.append(tok)
+        logits, cache = decode_step(params, tok, TINY, cache)
+
+    # reference: each sequence alone, unpadded
+    for i, ln in enumerate(lens):
+        c = init_cache(TINY, 1, 16)
+        lg, c = prefill(params, tokens[i:i + 1, :ln], TINY, c)
+        for t in range(4):
+            tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            assert int(tok[0]) == int(ragged_out[t][i]), (
+                f"seq {i} diverged at decode step {t}")
+            lg, c = decode_step(params, tok, TINY, c)
+
+
 def test_sampling_distribution_respects_top_k():
     logits = jnp.array([[0.0, 0.1, 0.2, 5.0]])
     cfg = InferConfig(temperature=1.0, top_k=2)
