@@ -1,3 +1,8 @@
+from cloud_server_tpu.training.checkpoint import (  # noqa: F401
+    Checkpointer,
+    abstract_train_state,
+    restore_or_init,
+)
 from cloud_server_tpu.training.optim import make_optimizer  # noqa: F401
 from cloud_server_tpu.training.train_step import (  # noqa: F401
     TrainState,

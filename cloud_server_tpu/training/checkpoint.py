@@ -1,0 +1,131 @@
+"""Checkpoint / resume — Orbax-backed, sharding-aware.
+
+Save path: the whole `TrainState` pytree goes through Orbax's standard
+(tensorstore/OCDBT) handler; with async enabled the device arrays are
+snapshotted to host and serialisation overlaps the next training steps.
+
+Restore path: the caller supplies the *target* mesh/shardings (via
+`abstract_train_state`), so each process reads only the shards it owns
+straight from the checkpoint — no full-replica materialisation on any
+host. The restore mesh may differ from the save mesh (Orbax reshards on
+read), which is what makes elastic resume — restoring on a different
+topology after a failure — work without a conversion step.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+import orbax.checkpoint as ocp
+
+from cloud_server_tpu.config import ModelConfig, TrainConfig
+from cloud_server_tpu.models import transformer
+from cloud_server_tpu.parallel.sharding import DEFAULT_RULES
+from cloud_server_tpu.training.optim import make_optimizer
+from cloud_server_tpu.training.train_step import TrainState, state_shardings
+
+
+def abstract_train_state(model_cfg: ModelConfig, train_cfg: TrainConfig,
+                         mesh, rules=DEFAULT_RULES,
+                         loss_fn_module=transformer) -> TrainState:
+    """TrainState of ShapeDtypeStructs carrying the target mesh's shardings.
+
+    This is the `target` a sharded restore needs: shape/dtype say *what* to
+    read, the attached NamedSharding says *where* each shard lands.
+    """
+    shardings = state_shardings(model_cfg, mesh, rules, loss_fn_module)
+    opt = make_optimizer(train_cfg)
+
+    def init_fn(rng):
+        params = loss_fn_module.init_params(model_cfg, rng)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt.init(params))
+
+    shapes = jax.eval_shape(init_fn, jax.random.key(0))
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes, shardings)
+
+
+class Checkpointer:
+    """Step-indexed checkpoint manager for TrainState pytrees.
+
+    Thin policy layer over `ocp.CheckpointManager`: retention
+    (`max_to_keep`), cadence (`save_interval_steps` — `save()` is a no-op
+    off-cadence so the train loop can call it every step), and async save.
+    """
+
+    def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 3,
+                 save_interval_steps: int = 1, async_save: bool = True):
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save,
+        )
+        self._mngr = ocp.CheckpointManager(
+            os.path.abspath(os.fspath(directory)), options=options)
+
+    # -- save ---------------------------------------------------------------
+
+    def save(self, state: TrainState, *, metrics: dict | None = None,
+             force: bool = False) -> bool:
+        """Save `state` at its own step counter. Returns False when skipped
+        (off-cadence for save_interval_steps, or step already saved)."""
+        step = int(jax.device_get(state.step))
+        return self._mngr.save(
+            step, args=ocp.args.StandardSave(state), metrics=metrics,
+            force=force)
+
+    # -- restore ------------------------------------------------------------
+
+    def restore(self, target: TrainState, step: int | None = None) -> TrainState:
+        """Sharded restore. `target` comes from `abstract_train_state` (or is
+        a concrete TrainState, whose shardings are reused). Restores the
+        latest step unless `step` is given."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under {self._mngr.directory}")
+        return self._mngr.restore(step, args=ocp.args.StandardRestore(target))
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return list(self._mngr.all_steps())
+
+    def wait(self) -> None:
+        """Block until in-flight async saves are durable."""
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+    def __enter__(self) -> "Checkpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def restore_or_init(ckpt: Checkpointer, model_cfg: ModelConfig,
+                    train_cfg: TrainConfig, mesh, rng: jax.Array,
+                    rules=DEFAULT_RULES,
+                    loss_fn_module=transformer) -> tuple[TrainState, bool]:
+    """The resume entry point a train loop calls once at startup: restore
+    the latest checkpoint onto `mesh` if one exists, else init fresh.
+    Returns (state, resumed)."""
+    from cloud_server_tpu.training.train_step import init_train_state
+    if ckpt.latest_step() is not None:
+        target = abstract_train_state(model_cfg, train_cfg, mesh, rules,
+                                      loss_fn_module)
+        return ckpt.restore(target), True
+    return init_train_state(model_cfg, train_cfg, mesh, rng, rules,
+                            loss_fn_module), False
