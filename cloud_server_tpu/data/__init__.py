@@ -1,0 +1,10 @@
+from cloud_server_tpu.data.dataset import (  # noqa: F401
+    MemmapTokenDataset,
+    SyntheticLMDataset,
+    write_token_file,
+)
+from cloud_server_tpu.data.loader import (  # noqa: F401
+    DataLoader,
+    ShardedSampler,
+    prefetch_to_device,
+)

@@ -1,0 +1,76 @@
+"""Token datasets for LM pretraining.
+
+The on-disk format is the standard flat binary token stream (a single
+dtype'd array of token ids, as produced by most tokenizer pipelines);
+`MemmapTokenDataset` views it zero-copy via np.memmap and slices fixed
+seq_len windows, so the host never holds more than the batches in flight.
+The optional native C++ reader in `cloud_server_tpu.runtime` reads the
+same format with O_DIRECT-style threaded prefetch; this module is the
+always-available pure-numpy path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def write_token_file(path: str | os.PathLike, tokens: np.ndarray,
+                     dtype=np.uint16) -> None:
+    """Write a flat token array in the binary format the readers expect."""
+    np.asarray(tokens, dtype=dtype).tofile(os.fspath(path))
+
+
+class MemmapTokenDataset:
+    """Fixed-window LM dataset over a flat binary token file.
+
+    Example i is tokens[i*seq_len : (i+1)*seq_len]; windows are
+    non-overlapping and the tail that doesn't fill one is dropped. The
+    train loss shifts within the window (`next_token_loss` drops the last
+    position), so windows stay exactly seq_len — which keeps S divisible
+    for sp-sharded attention.
+    """
+
+    def __init__(self, path: str | os.PathLike, seq_len: int,
+                 dtype=np.uint16):
+        self.path = os.fspath(path)
+        self.seq_len = seq_len
+        self._tokens = np.memmap(self.path, dtype=dtype, mode="r")
+        n = len(self._tokens) // seq_len
+        if n <= 0:
+            raise ValueError(
+                f"{self.path}: {len(self._tokens)} tokens < seq_len "
+                f"({seq_len}); no full window fits")
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i: int) -> dict[str, np.ndarray]:
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        s = i * self.seq_len
+        return {"tokens": np.asarray(self._tokens[s:s + self.seq_len],
+                                     np.int32)}
+
+
+class SyntheticLMDataset:
+    """Deterministic random tokens — for tests and benches (no disk IO)."""
+
+    def __init__(self, num_examples: int, seq_len: int, vocab_size: int,
+                 seed: int = 0):
+        self._n = num_examples
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i: int) -> dict[str, np.ndarray]:
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        rng = np.random.default_rng((self.seed, i))
+        return {"tokens": rng.integers(
+            0, self.vocab_size, self.seq_len, dtype=np.int32)}

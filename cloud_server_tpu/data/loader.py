@@ -1,0 +1,163 @@
+"""Host data pipeline: deterministic sharded sampling, collation, and
+double-buffered device prefetch producing *global* sharded arrays.
+
+Multi-host model: every process runs an identical `ShardedSampler` (same
+seed ⇒ same per-epoch permutation), takes its own contiguous slice of each
+global batch, and `jax.make_array_from_process_local_data` assembles the
+logical global array from the per-process shards — the standard JAX
+multi-host input recipe (no process ever holds the full global batch).
+On a single process this degrades to a plain sharded device_put.
+
+Resume: the sampler's state is (epoch, batch_in_epoch) — two ints saved
+next to the model checkpoint — and `load_state_dict` fast-forwards
+without touching the data, so a resumed run sees the exact same stream.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+
+class ShardedSampler:
+    """Deterministic, resumable index sampler sharded across processes.
+
+    Each epoch draws a fresh permutation from (seed, epoch); each global
+    step takes `global_batch_size` indices and this process keeps its
+    `local_batch_size` slice. Incomplete trailing batches are dropped so
+    shapes stay static for jit.
+    """
+
+    def __init__(self, num_examples: int, global_batch_size: int, *,
+                 seed: int = 0, shuffle: bool = True,
+                 process_index: int | None = None,
+                 process_count: int | None = None):
+        self.num_examples = num_examples
+        self.global_batch_size = global_batch_size
+        self.seed = seed
+        self.shuffle = shuffle
+        self.process_index = (jax.process_index() if process_index is None
+                              else process_index)
+        self.process_count = (jax.process_count() if process_count is None
+                              else process_count)
+        if global_batch_size % self.process_count:
+            raise ValueError(
+                f"global_batch_size {global_batch_size} not divisible by "
+                f"process_count {self.process_count}")
+        self.local_batch_size = global_batch_size // self.process_count
+        self.batches_per_epoch = num_examples // global_batch_size
+        if self.batches_per_epoch == 0:
+            raise ValueError(
+                f"dataset of {num_examples} examples can't fill one global "
+                f"batch of {global_batch_size}")
+        self.epoch = 0
+        self.batch_in_epoch = 0
+
+    def _perm(self, epoch: int) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(self.num_examples)
+        return np.random.default_rng(
+            (self.seed, epoch)).permutation(self.num_examples)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        """Yield this process's index slice for each global batch, forever
+        (epochs advance automatically)."""
+        while True:
+            perm = self._perm(self.epoch)
+            while self.batch_in_epoch < self.batches_per_epoch:
+                g0 = self.batch_in_epoch * self.global_batch_size
+                local = perm[g0 + self.process_index * self.local_batch_size:
+                             g0 + (self.process_index + 1) * self.local_batch_size]
+                self.batch_in_epoch += 1
+                yield local
+            self.epoch += 1
+            self.batch_in_epoch = 0
+
+    def state_dict(self) -> dict[str, int]:
+        return {"epoch": self.epoch, "batch_in_epoch": self.batch_in_epoch}
+
+    def load_state_dict(self, state: dict[str, int]) -> None:
+        self.epoch = int(state["epoch"])
+        self.batch_in_epoch = int(state["batch_in_epoch"])
+
+
+def _collate(dataset, indices: np.ndarray) -> dict[str, np.ndarray]:
+    examples = [dataset[int(i)] for i in indices]
+    return {k: np.stack([e[k] for e in examples]) for k in examples[0]}
+
+
+def make_global_batch(local: dict[str, np.ndarray],
+                      sharding: NamedSharding) -> dict[str, jax.Array]:
+    """Assemble per-process local shards into global sharded jax.Arrays."""
+    return {k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in local.items()}
+
+
+def prefetch_to_device(it: Iterator[Any], size: int = 2) -> Iterator[Any]:
+    """Overlap host-side batch production with device compute.
+
+    A daemon thread runs the upstream iterator (dataset reads, collation,
+    device_put all happen there); the consumer pops ready batches from a
+    bounded queue. Device transfer is async in JAX, so by the time the
+    train step wants batch N+1 its copy has already been issued.
+    """
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=size)
+    _END = object()
+
+    def producer():
+        try:
+            for item in it:
+                q.put(item)
+        finally:
+            q.put(_END)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        yield item
+
+
+class DataLoader:
+    """dataset + sampler + collate + global-array assembly + prefetch.
+
+    Yields {"tokens": (global_B, S) jax.Array laid out as `sharding`}.
+    Iterate it forever (epochs advance inside the sampler); pair
+    `state_dict`/`load_state_dict` with the model checkpoint for exact
+    data-stream resume.
+    """
+
+    def __init__(self, dataset, global_batch_size: int,
+                 sharding: NamedSharding, *, seed: int = 0,
+                 shuffle: bool = True, prefetch: int = 2,
+                 process_index: int | None = None,
+                 process_count: int | None = None):
+        self.dataset = dataset
+        self.sharding = sharding
+        self.prefetch = prefetch
+        self.sampler = ShardedSampler(
+            len(dataset), global_batch_size, seed=seed, shuffle=shuffle,
+            process_index=process_index, process_count=process_count)
+
+    def _produce(self) -> Iterator[dict[str, jax.Array]]:
+        for indices in self.sampler:
+            yield make_global_batch(_collate(self.dataset, indices),
+                                    self.sharding)
+
+    def __iter__(self) -> Iterator[dict[str, jax.Array]]:
+        if self.prefetch > 0:
+            return prefetch_to_device(self._produce(), self.prefetch)
+        return self._produce()
+
+    def state_dict(self) -> dict[str, int]:
+        return self.sampler.state_dict()
+
+    def load_state_dict(self, state: dict[str, int]) -> None:
+        self.sampler.load_state_dict(state)

@@ -1,0 +1,124 @@
+"""Data pipeline: memmap format, deterministic sharded sampling, resume,
+global sharded batch assembly, prefetch, end-to-end with the train step."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cloud_server_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from cloud_server_tpu.data import (
+    DataLoader, MemmapTokenDataset, ShardedSampler, SyntheticLMDataset,
+    write_token_file)
+from cloud_server_tpu.parallel.mesh import make_mesh
+
+
+def _token_file(tmp_path, n_tokens=1000, vocab=100, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, vocab, n_tokens, dtype=np.uint16)
+    path = tmp_path / "tokens.bin"
+    write_token_file(path, toks)
+    return path, toks
+
+
+def test_memmap_dataset_windows(tmp_path):
+    path, toks = _token_file(tmp_path, n_tokens=105)
+    ds = MemmapTokenDataset(path, seq_len=10)
+    assert len(ds) == 10  # tail of 5 dropped
+    np.testing.assert_array_equal(ds[3]["tokens"], toks[30:40].astype(np.int32))
+    with pytest.raises(IndexError):
+        ds[10]
+
+
+def test_memmap_dataset_too_small(tmp_path):
+    path, _ = _token_file(tmp_path, n_tokens=5)
+    with pytest.raises(ValueError, match="no full window"):
+        MemmapTokenDataset(path, seq_len=10)
+
+
+def test_sampler_covers_epoch_without_repeats():
+    s = ShardedSampler(100, 10, seed=0, process_index=0, process_count=1)
+    it = iter(s)
+    seen = np.concatenate([next(it) for _ in range(10)])
+    assert sorted(seen.tolist()) == list(range(100))
+
+
+def test_sampler_process_shards_partition_the_global_batch():
+    """Two processes' slices concatenate to the single-process batch."""
+    full = iter(ShardedSampler(64, 8, seed=3, process_index=0,
+                               process_count=1))
+    p0 = iter(ShardedSampler(64, 8, seed=3, process_index=0, process_count=2))
+    p1 = iter(ShardedSampler(64, 8, seed=3, process_index=1, process_count=2))
+    for _ in range(16):  # crosses an epoch boundary
+        f, a, b = next(full), next(p0), next(p1)
+        np.testing.assert_array_equal(f, np.concatenate([a, b]))
+
+
+def test_sampler_resume_continues_stream():
+    ref = iter(ShardedSampler(96, 8, seed=1))
+    ref_batches = [next(ref) for _ in range(20)]
+
+    s = ShardedSampler(96, 8, seed=1)
+    it = iter(s)
+    for _ in range(7):
+        next(it)
+    state = s.state_dict()
+
+    s2 = ShardedSampler(96, 8, seed=1)
+    s2.load_state_dict(state)
+    got = [next(iter(s2)) for _ in range(13)]
+    for want, g in zip(ref_batches[7:], got):
+        np.testing.assert_array_equal(want, g)
+
+
+def test_loader_yields_sharded_global_arrays():
+    mesh = make_mesh(MeshConfig(dp=4, tp=2))
+    sharding = NamedSharding(mesh, P(("dp",), None))
+    ds = SyntheticLMDataset(64, seq_len=16, vocab_size=100)
+    dl = DataLoader(ds, global_batch_size=8, sharding=sharding, prefetch=2)
+    it = iter(dl)
+    batch = next(it)
+    assert batch["tokens"].shape == (8, 16)
+    assert batch["tokens"].sharding == sharding
+    assert str(batch["tokens"].dtype) == "int32"
+
+
+def test_loader_deterministic_across_prefetch_settings():
+    mesh = make_mesh(MeshConfig(dp=8))
+    sharding = NamedSharding(mesh, P(("dp",), None))
+    ds = SyntheticLMDataset(64, seq_len=8, vocab_size=50)
+    a = iter(DataLoader(ds, 8, sharding, seed=5, prefetch=0))
+    b = iter(DataLoader(ds, 8, sharding, seed=5, prefetch=3))
+    for _ in range(10):
+        np.testing.assert_array_equal(np.asarray(next(a)["tokens"]),
+                                      np.asarray(next(b)["tokens"]))
+
+
+def test_loader_feeds_train_step(tmp_path):
+    """End to end: binary file -> loader -> sharded train step, loss drops."""
+    from cloud_server_tpu.training import init_train_state, make_train_step
+
+    cfg = ModelConfig(vocab_size=64, embed_dim=32, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=8, mlp_dim=64, max_seq_len=32,
+                      dtype="float32", param_dtype="float32", remat="none")
+    tcfg = TrainConfig(batch_size=8, seq_len=16, warmup_steps=2,
+                       total_steps=30, learning_rate=1e-2)
+    # low-entropy stream so 8 steps visibly reduce loss
+    toks = np.tile(np.arange(16, dtype=np.uint16), 200)
+    path = tmp_path / "t.bin"
+    write_token_file(path, toks)
+    ds = MemmapTokenDataset(path, seq_len=16)
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    state = init_train_state(cfg, tcfg, mesh, jax.random.key(0))
+    step, batch_sharding = make_train_step(cfg, tcfg, mesh)
+    dl = DataLoader(ds, global_batch_size=8, sharding=batch_sharding, seed=0)
+
+    losses = []
+    for i, batch in enumerate(dl):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+        if i == 7:
+            break
+    assert losses[-1] < losses[0], losses
