@@ -1,0 +1,5 @@
+from cloud_server_tpu.runtime.native import (  # noqa: F401
+    NativeTokenDataset,
+    load_library,
+    native_available,
+)
