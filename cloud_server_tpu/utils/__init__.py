@@ -1,0 +1,15 @@
+from cloud_server_tpu.utils.logging import MetricLogger, read_jsonl  # noqa: F401
+from cloud_server_tpu.utils.metrics import (  # noqa: F401
+    DEVICE_PEAK_FLOPS,
+    MetricAggregator,
+    StepTimer,
+    param_count,
+    peak_flops_per_device,
+    transformer_flops_per_token,
+)
+from cloud_server_tpu.utils.tracing import (  # noqa: F401
+    StepProfiler,
+    annotate,
+    capture_trace,
+    start_profiler_server,
+)

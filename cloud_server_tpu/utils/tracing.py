@@ -1,0 +1,62 @@
+"""Profiling hooks around jax.profiler.
+
+`annotate` names a host-side region so it shows up on the TensorBoard
+trace timeline; `capture_trace` wraps a step window in a full XLA/TPU
+trace dump; `start_profiler_server` enables on-demand remote capture
+(the standard workflow against a live training job).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def capture_trace(logdir: str | os.PathLike) -> Iterator[None]:
+    """Capture a device+host trace for the enclosed block into `logdir`
+    (view with TensorBoard's profile plugin or Perfetto)."""
+    jax.profiler.start_trace(os.fspath(logdir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_profiler_server(port: int = 9999):
+    """Expose this process to on-demand profiling (tensorboard capture)."""
+    return jax.profiler.start_server(port)
+
+
+class StepProfiler:
+    """Trace a half-open step window [start, stop) of a training loop:
+    profiles steady-state steps while skipping compile/warmup."""
+
+    def __init__(self, logdir: str | os.PathLike, *, start_step: int,
+                 num_steps: int = 3):
+        self.logdir = os.fspath(logdir)
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+
+    def step(self, step: int) -> None:
+        if step == self.start_step and not self._active:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif step >= self.stop_step and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
