@@ -1,0 +1,104 @@
+"""Training CLI: `python -m cloud_server_tpu.train`.
+
+Config comes from a JSON file with optional sections {"model", "train",
+"mesh", "loop"} (each deserialised into the corresponding dataclass in
+`config.py` / `training/loop.py`), with common fields overridable from the
+command line. Data is either a flat binary token file (`--data`, the
+`MemmapTokenDataset` format) or `--synthetic N` random examples for
+smoke runs.
+
+Multi-host: pass `--distributed` to call `jax.distributed.initialize()`
+before anything touches the backend; every process runs this same command
+and the data/checkpoint layers shard per-process automatically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m cloud_server_tpu.train",
+        description="Train a dense or MoE decoder LM on TPU.")
+    p.add_argument("--config", help="JSON config file with optional "
+                   "model/train/mesh/loop sections")
+    p.add_argument("--data", help="flat binary token file (uint16)")
+    p.add_argument("--eval-data", help="eval token file (same format)")
+    p.add_argument("--synthetic", type=int, default=0, metavar="N",
+                   help="use N synthetic random examples instead of --data")
+    p.add_argument("--steps", type=int, help="override train.total_steps")
+    p.add_argument("--batch-size", type=int, help="override train.batch_size")
+    p.add_argument("--seq-len", type=int, help="override train.seq_len")
+    p.add_argument("--learning-rate", type=float,
+                   help="override train.learning_rate")
+    p.add_argument("--checkpoint-dir", help="override loop.checkpoint_dir")
+    p.add_argument("--logdir", help="override loop.logdir")
+    p.add_argument("--distributed", action="store_true",
+                   help="call jax.distributed.initialize() (multi-host)")
+    return p
+
+
+def configs_from_args(args) -> tuple:
+    """(ModelConfig, TrainConfig, MeshConfig, LoopConfig) from file + flags."""
+    from cloud_server_tpu.config import (
+        MeshConfig, ModelConfig, TrainConfig, from_json)
+    from cloud_server_tpu.training.loop import LoopConfig
+
+    raw = {}
+    if args.config:
+        with open(args.config) as f:
+            raw = json.load(f)
+    model_cfg = from_json(ModelConfig, raw.get("model", {}))
+    train_cfg = from_json(TrainConfig, raw.get("train", {}))
+    mesh_cfg = from_json(MeshConfig, raw.get("mesh", {}))
+    loop_cfg = from_json(LoopConfig, raw.get("loop", {}))
+
+    train_over = {k: v for k, v in {
+        "total_steps": args.steps, "batch_size": args.batch_size,
+        "seq_len": args.seq_len, "learning_rate": args.learning_rate,
+    }.items() if v is not None}
+    if train_over:
+        train_cfg = dataclasses.replace(train_cfg, **train_over)
+    loop_over = {k: v for k, v in {
+        "checkpoint_dir": args.checkpoint_dir, "logdir": args.logdir,
+    }.items() if v is not None}
+    if loop_over:
+        loop_cfg = dataclasses.replace(loop_cfg, **loop_over)
+    return model_cfg, train_cfg, mesh_cfg, loop_cfg
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    if args.distributed:
+        import jax
+        jax.distributed.initialize()
+
+    from cloud_server_tpu.data.dataset import (
+        MemmapTokenDataset, SyntheticLMDataset)
+    from cloud_server_tpu.models import moe as moe_module, transformer
+    from cloud_server_tpu.training.loop import train_loop
+
+    model_cfg, train_cfg, mesh_cfg, loop_cfg = configs_from_args(args)
+
+    if args.synthetic:
+        dataset = SyntheticLMDataset(args.synthetic, train_cfg.seq_len,
+                                     model_cfg.vocab_size,
+                                     seed=train_cfg.seed)
+    elif args.data:
+        dataset = MemmapTokenDataset(args.data, train_cfg.seq_len)
+    else:
+        raise SystemExit("one of --data or --synthetic is required")
+    eval_dataset = (MemmapTokenDataset(args.eval_data, train_cfg.seq_len)
+                    if args.eval_data else None)
+
+    loss_fn_module = moe_module if model_cfg.num_experts >= 2 else transformer
+    train_loop(model_cfg, train_cfg, dataset, mesh_cfg=mesh_cfg,
+               loop_cfg=loop_cfg, eval_dataset=eval_dataset,
+               loss_fn_module=loss_fn_module)
+
+
+if __name__ == "__main__":
+    main()

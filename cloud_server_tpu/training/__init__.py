@@ -3,6 +3,11 @@ from cloud_server_tpu.training.checkpoint import (  # noqa: F401
     abstract_train_state,
     restore_or_init,
 )
+from cloud_server_tpu.training.eval import (  # noqa: F401
+    evaluate,
+    make_eval_step,
+)
+from cloud_server_tpu.training.loop import LoopConfig, train_loop  # noqa: F401
 from cloud_server_tpu.training.optim import make_optimizer  # noqa: F401
 from cloud_server_tpu.training.train_step import (  # noqa: F401
     TrainState,

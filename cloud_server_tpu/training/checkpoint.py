@@ -75,6 +75,8 @@ class Checkpointer:
         """Save `state` at its own step counter. Returns False when skipped
         (off-cadence for save_interval_steps, or step already saved)."""
         step = int(jax.device_get(state.step))
+        if step in self._mngr.all_steps():
+            return False  # even force=True must not collide with a done save
         return self._mngr.save(
             step, args=ocp.args.StandardSave(state), metrics=metrics,
             force=force)

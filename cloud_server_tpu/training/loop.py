@@ -1,0 +1,184 @@
+"""The full training loop: data → sharded step → metrics/ckpt/eval.
+
+Orchestrates every subsystem the framework provides:
+  * mesh construction + the jitted sharded train step (`train_step.py`),
+  * the resumable sharded data pipeline (`data/loader.py`) with its state
+    fast-forwarded from the restored step (the sampler is deterministic in
+    (seed, step), so no separate data-state file is needed),
+  * Orbax checkpointing with cadence/retention (`checkpoint.py`),
+  * throughput/MFU accounting + JSONL logging (`utils/`),
+  * periodic token-weighted evaluation (`eval.py`),
+  * failure hooks — callables invoked every step with (step, state,
+    metrics); a hook may raise to abort or return a replacement state
+    (used by the NaN-guard / watchdog in `utils/failure.py`).
+
+Blocking discipline: the loop only blocks on device results at log
+boundaries, so up to `log_interval` steps stay in flight and host-side
+work (data, logging, checkpoint serialisation) overlaps device compute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import jax
+
+from cloud_server_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from cloud_server_tpu.models import transformer
+from cloud_server_tpu.data.loader import DataLoader
+from cloud_server_tpu.parallel.mesh import make_mesh
+from cloud_server_tpu.parallel.sharding import DEFAULT_RULES
+from cloud_server_tpu.training.checkpoint import Checkpointer, restore_or_init
+from cloud_server_tpu.training.eval import evaluate, make_eval_step
+from cloud_server_tpu.training.train_step import make_train_step
+from cloud_server_tpu.utils.logging import MetricLogger
+from cloud_server_tpu.utils.metrics import (
+    MetricAggregator, StepTimer, transformer_flops_per_token)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoopConfig:
+    """Knobs of the loop itself (cadences, paths) — everything that is not
+    model/mesh/optimizer math."""
+
+    log_interval: int = 10  # 0 => log only at the end of the run
+    logdir: str | None = None
+    checkpoint_dir: str | None = None
+    checkpoint_interval: int = 500  # 0 => final save only
+    max_checkpoints: int = 3
+    async_checkpoint: bool = True
+    eval_interval: int = 0  # 0 => no periodic eval
+    eval_batches: int = 16
+    data_prefetch: int = 2
+    shuffle: bool = True
+
+
+# A hook sees (step, state, metrics) after each train step. It may return
+# None (observe only) or a replacement TrainState (e.g. rollback).
+Hook = Callable[[int, object, dict], object | None]
+
+
+def train_loop(model_cfg: ModelConfig, train_cfg: TrainConfig,
+               dataset, *, mesh_cfg: MeshConfig | None = None,
+               loop_cfg: LoopConfig | None = None, eval_dataset=None,
+               rules=None, loss_fn_module=transformer, loss_fn=None,
+               hooks: Sequence[Hook] = (), max_steps: int | None = None):
+    """Run training to `train_cfg.total_steps`; returns the final TrainState.
+
+    Resumes automatically from `loop_cfg.checkpoint_dir` when a checkpoint
+    exists there (restoring onto the *current* mesh, which may differ from
+    the save mesh — elastic resume). `max_steps` stops *this run* early
+    (e.g. to simulate preemption) without touching `total_steps`, which
+    the LR schedule depends on.
+    """
+    loop_cfg = loop_cfg or LoopConfig()
+    rules = rules or DEFAULT_RULES
+    mesh = make_mesh(mesh_cfg or MeshConfig())
+
+    step_fn, batch_sharding = make_train_step(
+        model_cfg, train_cfg, mesh, rules=rules, loss_fn=loss_fn,
+        loss_fn_module=loss_fn_module)
+
+    ckpt = None
+    if loop_cfg.checkpoint_dir is not None:
+        ckpt = Checkpointer(
+            loop_cfg.checkpoint_dir, max_to_keep=loop_cfg.max_checkpoints,
+            save_interval_steps=max(1, loop_cfg.checkpoint_interval),
+            async_save=loop_cfg.async_checkpoint)
+        state, resumed = restore_or_init(
+            ckpt, model_cfg, train_cfg, mesh, jax.random.key(train_cfg.seed),
+            rules, loss_fn_module)
+    else:
+        from cloud_server_tpu.training.train_step import init_train_state
+        state = init_train_state(model_cfg, train_cfg, mesh,
+                                 jax.random.key(train_cfg.seed), rules,
+                                 loss_fn_module)
+        resumed = False
+    start_step = int(jax.device_get(state.step))
+
+    loader = DataLoader(dataset, train_cfg.batch_size, batch_sharding,
+                        seed=train_cfg.seed, shuffle=loop_cfg.shuffle,
+                        prefetch=loop_cfg.data_prefetch)
+    # Deterministic data resume: one train step consumes one global batch,
+    # so the sampler position is a pure function of the restored step.
+    bpe = loader.sampler.batches_per_epoch
+    loader.load_state_dict({"epoch": start_step // bpe,
+                            "batch_in_epoch": start_step % bpe})
+
+    eval_step = None
+    if eval_dataset is not None and loop_cfg.eval_interval > 0:
+        eval_step, eval_sharding = make_eval_step(
+            model_cfg, mesh, rules, loss_fn_module, loss_fn=loss_fn)
+        # prefetch=0: evaluate() stops mid-stream after eval_batches, and an
+        # abandoned prefetch thread would block forever on its full queue,
+        # leaking a thread + device batches per eval.
+        eval_loader = DataLoader(
+            eval_dataset, train_cfg.batch_size, eval_sharding,
+            seed=train_cfg.seed, shuffle=False, prefetch=0)
+
+    tokens_per_step = train_cfg.batch_size * train_cfg.seq_len
+    timer = StepTimer(
+        flops_per_token=transformer_flops_per_token(
+            model_cfg, train_cfg.seq_len),
+        window=max(1, 100 // max(1, loop_cfg.log_interval)))
+    agg = MetricAggregator()
+    logger = MetricLogger(loop_cfg.logdir)
+    if resumed:
+        print(f"[loop] resumed from step {start_step} "
+              f"({loop_cfg.checkpoint_dir})")
+
+    stop_at = train_cfg.total_steps if max_steps is None else min(
+        train_cfg.total_steps, max_steps)
+    data_it = iter(loader)
+    step = last_logged = start_step
+    try:
+        while step < stop_at:
+            batch = next(data_it)
+            state, metrics = step_fn(state, batch)
+            step += 1
+            agg.update(metrics)
+
+            for hook in hooks:
+                replacement = hook(step, state, metrics)
+                if replacement is not None:
+                    state = replacement
+
+            if ((loop_cfg.log_interval > 0
+                 and step % loop_cfg.log_interval == 0) or step == stop_at):
+                jax.block_until_ready(metrics["loss"])
+                flushed = agg.flush()
+                flushed.update(timer.tick(
+                    tokens_per_step * (step - last_logged)))
+                last_logged = step
+                logger.log(step, flushed)
+
+            if eval_step is not None and step % loop_cfg.eval_interval == 0:
+                eval_loader.load_state_dict({"epoch": 0, "batch_in_epoch": 0})
+                eval_metrics = evaluate(
+                    state.params, iter(eval_loader), eval_step,
+                    max_batches=loop_cfg.eval_batches)
+                logger.log(step, eval_metrics)
+
+            # Only touch the checkpointer on-cadence: Checkpointer.save reads
+            # state.step from device, which would force a per-step sync.
+            if (ckpt is not None and loop_cfg.checkpoint_interval > 0
+                    and step % loop_cfg.checkpoint_interval == 0):
+                ckpt.save(state)
+    except KeyboardInterrupt:
+        # Preemption-style interrupt: the in-flight state is still valid —
+        # persist it so the next launch resumes from here.
+        if ckpt is not None:
+            ckpt.save(state, force=True)
+        raise
+    else:
+        if ckpt is not None:
+            ckpt.save(state, force=True)
+    finally:
+        # Any other exception (e.g. a NaN-guard hook aborting) must NOT
+        # save: it would checkpoint corrupt params and retention could
+        # evict the last good checkpoint.
+        if ckpt is not None:
+            ckpt.close()
+        logger.close()
+    return state

@@ -3,5 +3,6 @@
 # PALLAS_AXON_POOL_IPS stops sitecustomize from dialing the TPU relay
 # (one relay session per python process wedges concurrent runs and is
 # pointless for CPU tests).
+if [ "$#" -eq 0 ]; then set -- -x -q; fi
 exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python -m pytest tests/ "${@:--x -q}"
+    python -m pytest tests/ "$@"
