@@ -36,8 +36,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="override train.learning_rate")
     p.add_argument("--checkpoint-dir", help="override loop.checkpoint_dir")
     p.add_argument("--logdir", help="override loop.logdir")
+    p.add_argument("--eval-interval", type=int,
+                   help="override loop.eval_interval (defaults to 500 when "
+                   "--eval-data is given and the config leaves it 0)")
     p.add_argument("--distributed", action="store_true",
                    help="call jax.distributed.initialize() (multi-host)")
+    p.add_argument("--no-nan-guard", action="store_true",
+                   help="disable the NaN/inf loss guard")
+    p.add_argument("--watchdog", type=float, default=0.0, metavar="SECONDS",
+                   help="abort (with stack dump) if a step makes no "
+                   "progress for this long; 0 disables")
     return p
 
 
@@ -64,7 +72,14 @@ def configs_from_args(args) -> tuple:
         train_cfg = dataclasses.replace(train_cfg, **train_over)
     loop_over = {k: v for k, v in {
         "checkpoint_dir": args.checkpoint_dir, "logdir": args.logdir,
+        "eval_interval": args.eval_interval,
     }.items() if v is not None}
+    # --eval-data with eval_interval 0 would silently never evaluate.
+    if getattr(args, "eval_data", None) and "eval_interval" not in loop_over \
+            and loop_cfg.eval_interval == 0:
+        loop_over["eval_interval"] = 500
+        print("[train] --eval-data given without eval_interval; "
+              "defaulting loop.eval_interval=500")
     if loop_over:
         loop_cfg = dataclasses.replace(loop_cfg, **loop_over)
     return model_cfg, train_cfg, mesh_cfg, loop_cfg
@@ -95,9 +110,23 @@ def main(argv=None) -> None:
                     if args.eval_data else None)
 
     loss_fn_module = moe_module if model_cfg.num_experts >= 2 else transformer
-    train_loop(model_cfg, train_cfg, dataset, mesh_cfg=mesh_cfg,
-               loop_cfg=loop_cfg, eval_dataset=eval_dataset,
-               loss_fn_module=loss_fn_module)
+
+    import contextlib
+
+    from cloud_server_tpu.utils.failure import (
+        NaNGuard, PreemptionHandler, Watchdog)
+
+    hooks = []
+    with contextlib.ExitStack() as stack:
+        preempt = stack.enter_context(PreemptionHandler())
+        hooks.append(preempt)  # SIGTERM -> save + clean exit
+        if not args.no_nan_guard:
+            hooks.append(NaNGuard())
+        if args.watchdog > 0:
+            hooks.append(stack.enter_context(Watchdog(args.watchdog)))
+        train_loop(model_cfg, train_cfg, dataset, mesh_cfg=mesh_cfg,
+                   loop_cfg=loop_cfg, eval_dataset=eval_dataset,
+                   loss_fn_module=loss_fn_module, hooks=hooks)
 
 
 if __name__ == "__main__":

@@ -9,6 +9,7 @@ count), which makes the result independent of batch size.
 
 from __future__ import annotations
 
+import itertools
 import math
 from typing import Iterable
 
@@ -69,9 +70,9 @@ def evaluate(params, batches: Iterable[dict], eval_step,
     nll = 0.0
     n_tokens = 0.0
     n_correct = 0.0
-    for i, batch in enumerate(batches):
-        if max_batches is not None and i >= max_batches:
-            break
+    if max_batches is not None:
+        batches = itertools.islice(batches, max_batches)
+    for batch in batches:
         out = jax.device_get(eval_step(params, batch))
         nll += float(out["nll_sum"])
         n_tokens += float(out["n_tokens"])

@@ -59,6 +59,16 @@ class LoopConfig:
 Hook = Callable[[int, object, dict], object | None]
 
 
+def _beat_hooks(hooks: Sequence[Hook]) -> None:
+    """Heartbeat protocol: hooks exposing `beat()` (e.g. a Watchdog) are
+    beaten around long hook-free phases — eval sweeps, checkpoint writes —
+    so those phases only need to finish within one watchdog timeout."""
+    for h in hooks:
+        beat = getattr(h, "beat", None)
+        if callable(beat):
+            beat()
+
+
 def train_loop(model_cfg: ModelConfig, train_cfg: TrainConfig,
                dataset, *, mesh_cfg: MeshConfig | None = None,
                loop_cfg: LoopConfig | None = None, eval_dataset=None,
@@ -154,21 +164,25 @@ def train_loop(model_cfg: ModelConfig, train_cfg: TrainConfig,
                 logger.log(step, flushed)
 
             if eval_step is not None and step % loop_cfg.eval_interval == 0:
+                _beat_hooks(hooks)
                 eval_loader.load_state_dict({"epoch": 0, "batch_in_epoch": 0})
                 eval_metrics = evaluate(
                     state.params, iter(eval_loader), eval_step,
                     max_batches=loop_cfg.eval_batches)
                 logger.log(step, eval_metrics)
+                _beat_hooks(hooks)
 
             # Only touch the checkpointer on-cadence: Checkpointer.save reads
             # state.step from device, which would force a per-step sync.
             if (ckpt is not None and loop_cfg.checkpoint_interval > 0
                     and step % loop_cfg.checkpoint_interval == 0):
                 ckpt.save(state)
+                _beat_hooks(hooks)
     except KeyboardInterrupt:
         # Preemption-style interrupt: the in-flight state is still valid —
         # persist it so the next launch resumes from here.
         if ckpt is not None:
+            _beat_hooks(hooks)
             ckpt.save(state, force=True)
         raise
     else:

@@ -1,3 +1,9 @@
+from cloud_server_tpu.utils.failure import (  # noqa: F401
+    NaNGuard,
+    PreemptionHandler,
+    TrainingDiverged,
+    Watchdog,
+)
 from cloud_server_tpu.utils.logging import MetricLogger, read_jsonl  # noqa: F401
 from cloud_server_tpu.utils.metrics import (  # noqa: F401
     DEVICE_PEAK_FLOPS,

@@ -1,0 +1,137 @@
+"""Failure detection & elastic recovery: NaN guard, preemption, watchdog."""
+
+import os
+import signal
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from cloud_server_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from cloud_server_tpu.data.dataset import SyntheticLMDataset
+from cloud_server_tpu.training.checkpoint import Checkpointer
+from cloud_server_tpu.training.loop import LoopConfig, train_loop
+from cloud_server_tpu.utils.failure import (
+    NaNGuard, PreemptionHandler, TrainingDiverged, Watchdog)
+
+TINY = ModelConfig(
+    vocab_size=64, embed_dim=32, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=8, mlp_dim=64, max_seq_len=32, dtype="float32",
+    param_dtype="float32", remat="none")
+TCFG = TrainConfig(learning_rate=1e-2, warmup_steps=2, total_steps=6,
+                   batch_size=8, seq_len=16)
+
+
+def _dataset(n=64):
+    return SyntheticLMDataset(n, TCFG.seq_len, TINY.vocab_size, seed=3)
+
+
+# -- NaNGuard ---------------------------------------------------------------
+
+def test_nan_guard_passes_finite_raises_nan():
+    guard = NaNGuard(check_interval=1)
+    assert guard(1, None, {"loss": jnp.float32(2.5)}) is None
+    with pytest.raises(TrainingDiverged):
+        guard(2, None, {"loss": jnp.float32(float("nan"))})
+
+
+def test_nan_guard_patience_allows_transient():
+    guard = NaNGuard(check_interval=1, patience=1)
+    guard(1, None, {"loss": jnp.float32(float("inf"))})  # tolerated
+    guard(2, None, {"loss": jnp.float32(1.0)})  # recovery resets streak
+    guard(3, None, {"loss": jnp.float32(float("inf"))})  # tolerated again
+    with pytest.raises(TrainingDiverged):
+        guard(4, None, {"loss": jnp.float32(float("nan"))})
+
+
+def test_nan_guard_respects_check_interval():
+    guard = NaNGuard(check_interval=5)
+    # off-cadence steps never touch the metric (a wrong key would throw)
+    assert guard(1, None, {}) is None
+    assert guard(4, None, {}) is None
+    with pytest.raises(TrainingDiverged):
+        guard(5, None, {"loss": jnp.float32(float("nan"))})
+
+
+def test_diverged_run_keeps_last_good_checkpoint(tmp_path, devices8):
+    """A NaN abort must not checkpoint the bad state."""
+    ck = str(tmp_path / "ck")
+
+    def poison(step, state, metrics):
+        if step == 4:
+            raise TrainingDiverged("injected")
+
+    with pytest.raises(TrainingDiverged):
+        train_loop(TINY, TCFG, _dataset(),
+                   loop_cfg=LoopConfig(log_interval=100, checkpoint_dir=ck,
+                                       checkpoint_interval=2),
+                   hooks=[poison])
+    saved = Checkpointer(ck).all_steps()
+    assert 2 in saved and 4 not in saved
+
+
+# -- PreemptionHandler ------------------------------------------------------
+
+def test_preemption_saves_and_reraises(tmp_path, devices8):
+    ck = str(tmp_path / "ck")
+    with PreemptionHandler(signals=(signal.SIGUSR1,)) as handler:
+        def preempt_at_3(step, state, metrics):
+            if step == 3:
+                os.kill(os.getpid(), signal.SIGUSR1)
+            return handler(step, state, metrics)
+
+        with pytest.raises(KeyboardInterrupt):
+            train_loop(TINY, TCFG, _dataset(),
+                       loop_cfg=LoopConfig(log_interval=100,
+                                           checkpoint_dir=ck,
+                                           checkpoint_interval=100),
+                       hooks=[preempt_at_3])
+    # signal landed during step 3's hook (same-process delivery is
+    # immediate), so the interrupt raised at step 3 — the interrupt path
+    # must have saved that exact step for elastic resume
+    assert Checkpointer(ck).latest_step() == 3
+
+    resumed = train_loop(TINY, TCFG, _dataset(),
+                         loop_cfg=LoopConfig(log_interval=100,
+                                             checkpoint_dir=ck,
+                                             checkpoint_interval=100))
+    assert int(resumed.step) == TCFG.total_steps
+
+
+def test_preemption_handler_restores_previous_signal():
+    before = signal.getsignal(signal.SIGUSR2)
+    with PreemptionHandler(signals=(signal.SIGUSR2,)):
+        assert signal.getsignal(signal.SIGUSR2) != before
+    assert signal.getsignal(signal.SIGUSR2) == before
+
+
+# -- Watchdog ---------------------------------------------------------------
+
+def test_watchdog_fires_on_silence_after_first_beat():
+    fired = []
+    with Watchdog(timeout_s=0.3, poll_s=0.05,
+                  on_hang=lambda t: fired.append(t)) as wd:
+        wd.beat()
+        time.sleep(0.6)
+    assert wd.fired and fired == [0.3]
+
+
+def test_watchdog_disarmed_until_first_beat():
+    """Startup work of unknown length (jit compile) must not fire it."""
+    fired = []
+    with Watchdog(timeout_s=0.1, poll_s=0.02,
+                  on_hang=lambda t: fired.append(t)) as wd:
+        time.sleep(0.4)  # long "compile", no beats yet
+        wd.beat()
+        time.sleep(0.05)
+    assert not wd.fired and not fired
+
+
+def test_watchdog_stays_quiet_with_heartbeats():
+    fired = []
+    with Watchdog(timeout_s=0.4, poll_s=0.05,
+                  on_hang=lambda t: fired.append(t)) as wd:
+        for _ in range(10):
+            wd.beat()
+            time.sleep(0.08)
+    assert not wd.fired and not fired
