@@ -1,3 +1,5 @@
 from cloud_server_tpu.inference.sampling import sample_logits  # noqa: F401
 from cloud_server_tpu.inference.engine import (  # noqa: F401
     KVCache, generate, init_cache, prefill)
+from cloud_server_tpu.inference.server import (  # noqa: F401
+    InferenceServer, Request)

@@ -1,0 +1,348 @@
+"""Serving layer: slot-based continuous batching over the inference engine.
+
+The TPU-first serving design: ONE persistent KV cache of static shape
+(L, max_slots, max_len, KH, Dh) lives on device for the server's lifetime.
+Each in-flight request owns a *slot* (a batch row). Admission prefills the
+prompt into its slot; a single jitted decode advances ALL active slots one
+token per call. Requests join and leave between decode steps — new work
+never waits for old work to finish (continuous batching), and shapes never
+change (no recompiles, no cache reallocation).
+
+Two jitted functions do all device work:
+  * admit:  prefill (1, Pb) → write the slot's cache region + sample the
+    first token. Prompt lengths are bucketed (next power of two) so the
+    prefill compiles once per bucket, not once per length.
+  * decode: one step over the full slot batch. Inactive slots are masked —
+    their length doesn't advance and they emit pad. Their cache writes
+    land at their frozen length position, which any later occupant
+    overwrites before it can ever be attended (write-at-pos happens before
+    attention reads pos), so no cross-request leakage is possible.
+
+The host side is a small scheduler: a pending queue, per-request token
+accumulation, EOS / max-token completion, optional streaming callbacks.
+One device_get of the (max_slots,) token vector per decode step is the
+only host↔device sync.
+
+Sharding: wrap `params` (and the server's jits inherit via input
+shardings) with tp/fsdp NamedShardings for multi-chip serving; the slot
+batch rides (dp, fsdp) exactly like training batches.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import threading
+from functools import partial
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from cloud_server_tpu.config import InferConfig, ModelConfig
+from cloud_server_tpu.inference import engine
+from cloud_server_tpu.inference.sampling import sample_logits
+
+
+class SlotState:
+    """Device-resident server state (a pytree)."""
+
+    def __init__(self, k, v, length, last_token, active):
+        self.k = k                    # (L, B, max_len, KH, Dh)
+        self.v = v
+        self.length = length          # (B,) int32
+        self.last_token = last_token  # (B,) int32
+        self.active = active          # (B,) bool
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.length, self.last_token,
+                self.active), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    SlotState, SlotState.tree_flatten, SlotState.tree_unflatten)
+
+
+def init_slot_state(cfg: ModelConfig, max_slots: int,
+                    max_len: int) -> SlotState:
+    cache = engine.init_cache(cfg, max_slots, max_len)
+    return SlotState(
+        k=cache.k, v=cache.v, length=cache.length,
+        last_token=jnp.zeros((max_slots,), jnp.int32),
+        active=jnp.zeros((max_slots,), bool))
+
+
+@partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
+def _admit(params, state: SlotState, prompt: jnp.ndarray,
+           true_len: jnp.ndarray, slot: jnp.ndarray, rng: jax.Array, *,
+           cfg: ModelConfig, infer_cfg: InferConfig):
+    """Prefill prompt (1, Pb) into `slot`; sample its first token.
+
+    `slot` is a traced scalar, so one compilation serves every slot; only
+    the padded prompt length Pb triggers a new compile (bucketed by the
+    caller).
+    """
+    pb = prompt.shape[1]
+    tmp = engine.init_cache(cfg, 1, pb)
+    logits, tmp = engine.prefill(params, prompt, cfg, tmp, true_len[None])
+    tok = sample_logits(logits, rng, infer_cfg)  # (1,)
+
+    k = lax.dynamic_update_slice(state.k, tmp.k, (0, slot, 0, 0, 0))
+    v = lax.dynamic_update_slice(state.v, tmp.v, (0, slot, 0, 0, 0))
+    return SlotState(
+        k=k, v=v,
+        length=state.length.at[slot].set(true_len),
+        last_token=state.last_token.at[slot].set(tok[0]),
+        active=state.active.at[slot].set(True))
+
+
+@partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
+def _decode(params, state: SlotState, rng: jax.Array, *, cfg: ModelConfig,
+            infer_cfg: InferConfig):
+    """One decode step over all slots; inactive slots are frozen.
+
+    Returns (state', tokens (B,) int32) with pad in inactive rows.
+    """
+    cache = engine.KVCache(state.k, state.v, state.length)
+    logits, cache = engine.decode_step(params, state.last_token, cfg, cache)
+    tok = sample_logits(logits, rng, infer_cfg)
+    tok = jnp.where(state.active, tok, infer_cfg.pad_token_id)
+    length = jnp.where(state.active, cache.length, state.length)
+    return SlotState(k=cache.k, v=cache.v, length=length, last_token=tok,
+                     active=state.active), tok
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _deactivate(state: SlotState, slot: jnp.ndarray) -> SlotState:
+    return SlotState(k=state.k, v=state.v, length=state.length,
+                     last_token=state.last_token,
+                     active=state.active.at[slot].set(False))
+
+
+@dataclasses.dataclass
+class Request:
+    """A generation request; thread-safe completion via `result()`."""
+
+    prompt: list[int]
+    max_new_tokens: int
+    stream: Callable[[int], None] | None = None
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    _done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    finish_reason: str | None = None  # "eos" | "length" | "error: ..."
+
+    def result(self, timeout: float | None = None) -> list[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation not finished")
+        if self.finish_reason and self.finish_reason.startswith("error"):
+            raise RuntimeError(f"generation failed: {self.finish_reason}")
+        return self.tokens
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt of {n} tokens exceeds largest bucket "
+                     f"{buckets[-1]}")
+
+
+class InferenceServer:
+    """Continuous-batching generation server.
+
+    submit() is thread-safe and returns immediately; step() performs one
+    scheduler iteration (admissions + one decode for all active slots).
+    Run steps manually, or `serve_forever()` on a thread via start()/stop().
+    """
+
+    def __init__(self, params, cfg: ModelConfig, infer_cfg: InferConfig, *,
+                 max_slots: int = 8, max_len: int = 1024,
+                 prompt_buckets: Sequence[int] | None = None, seed: int = 0):
+        self.params = params
+        self.cfg = cfg
+        self.infer_cfg = infer_cfg
+        self.max_slots = max_slots
+        self.max_len = max_len
+        if prompt_buckets is None:
+            # powers of two, with max_len itself always the last bucket so
+            # any prompt the cache can hold is admissible
+            prompt_buckets = [b for b in itertools.takewhile(
+                lambda b: b < max_len,
+                (2 ** i for i in range(4, 31)))] + [max_len]
+        self.prompt_buckets = sorted(prompt_buckets)
+        if self.prompt_buckets[-1] > max_len:
+            raise ValueError(
+                f"largest prompt bucket ({self.prompt_buckets[-1]}) exceeds "
+                f"max_len ({max_len}); the slot cache could not hold it")
+        self.state = init_slot_state(cfg, max_slots, max_len)
+        self._slots: list[Request | None] = [None] * max_slots
+        self._pending: collections.deque[Request] = collections.deque()
+        self._lock = threading.Lock()
+        # Serialises whole scheduler iterations: step() mutates self.state
+        # through buffer-donating jits, so two concurrent step() calls
+        # (e.g. run_until_idle() on an already start()ed server) would hand
+        # one thread a buffer the other just donated.
+        self._step_lock = threading.Lock()
+        self._rng = jax.random.key(seed)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- client API ---------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: int | None = None,
+               stream: Callable[[int], None] | None = None) -> Request:
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        _bucket(len(prompt), self.prompt_buckets)  # raises if too long
+        max_new = (self.infer_cfg.max_decode_len if max_new_tokens is None
+                   else max_new_tokens)
+        max_new = min(max_new, self.max_len - len(prompt))
+        if max_new <= 0:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens leaves no room to decode "
+                f"within max_len={self.max_len}")
+        req = Request(prompt=list(prompt), max_new_tokens=max_new,
+                      stream=stream)
+        with self._lock:
+            self._pending.append(req)
+        return req
+
+    def generate(self, prompts: Sequence[Sequence[int]], *,
+                 max_new_tokens: int | None = None) -> list[list[int]]:
+        """Synchronous convenience: submit all, drive to completion."""
+        reqs = [self.submit(p, max_new_tokens=max_new_tokens)
+                for p in prompts]
+        self.run_until_idle()
+        return [r.tokens for r in reqs]
+
+    # -- scheduler ----------------------------------------------------------
+
+    def _next_rng(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _emit(self, req: Request, token: int) -> bool:
+        """Record one generated token; True if the request just finished."""
+        if token == self.infer_cfg.eos_token_id:
+            req.finish_reason = "eos"
+            return True
+        req.tokens.append(token)
+        if req.stream is not None:
+            req.stream(token)
+        if len(req.tokens) >= req.max_new_tokens:
+            req.finish_reason = "length"
+            return True
+        return False
+
+    def _finish(self, slot: int, req: Request) -> None:
+        self._slots[slot] = None
+        self.state = _deactivate(self.state, jnp.int32(slot))
+        req._done.set()
+
+    def _admit_pending(self) -> None:
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                free = [i for i, r in enumerate(self._slots) if r is None]
+                if not free:
+                    return
+                req = self._pending.popleft()
+                slot = free[0]
+                self._slots[slot] = req
+            pb = _bucket(len(req.prompt), self.prompt_buckets)
+            prompt = np.full((1, pb), self.infer_cfg.pad_token_id, np.int32)
+            prompt[0, :len(req.prompt)] = req.prompt
+            self.state = _admit(
+                self.params, self.state, jnp.asarray(prompt),
+                jnp.int32(len(req.prompt)), jnp.int32(slot),
+                self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg)
+            # the admission token (sampled from prefill logits)
+            first = int(jax.device_get(self.state.last_token[slot]))
+            if self._emit(req, first):
+                self._finish(slot, req)
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    @property
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def step(self) -> int:
+        """One scheduler iteration; returns number of active slots.
+
+        Thread-safe: concurrent callers serialise on an internal lock.
+        """
+        with self._step_lock:
+            self._admit_pending()
+            if self.num_active == 0:
+                return 0
+            self.state, toks = _decode(
+                self.params, self.state, self._next_rng(),
+                cfg=self.cfg, infer_cfg=self.infer_cfg)
+            toks = np.asarray(jax.device_get(toks))
+            for slot, req in enumerate(self._slots):
+                if req is not None and self._emit(req, int(toks[slot])):
+                    self._finish(slot, req)
+            return self.num_active
+
+    def _fail_all(self, exc: BaseException) -> None:
+        """Unblock every in-flight and pending request after a fatal
+        scheduler error (otherwise result() waiters hang forever)."""
+        with self._lock:
+            pending, self._pending = list(self._pending), collections.deque()
+        for slot, req in enumerate(self._slots):
+            if req is not None:
+                self._slots[slot] = None
+                req.finish_reason = f"error: {exc!r}"
+                req._done.set()
+        for req in pending:
+            req.finish_reason = f"error: {exc!r}"
+            req._done.set()
+
+    def run_until_idle(self) -> None:
+        while self.num_pending or self.num_active:
+            self.step()
+
+    # -- background serving -------------------------------------------------
+
+    def serve_forever(self, idle_sleep_s: float = 0.002) -> None:
+        while not self._stop.is_set():
+            try:
+                busy = self.step()
+            except Exception as exc:  # noqa: BLE001 — must not hang clients
+                import traceback
+                traceback.print_exc()
+                self._fail_all(exc)
+                self._stop.set()
+                return
+            if busy == 0 and self.num_pending == 0:
+                self._stop.wait(idle_sleep_s)
+
+    def start(self) -> "InferenceServer":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True, name="inference-server")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None

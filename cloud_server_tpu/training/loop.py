@@ -187,6 +187,7 @@ def train_loop(model_cfg: ModelConfig, train_cfg: TrainConfig,
         raise
     else:
         if ckpt is not None:
+            _beat_hooks(hooks)  # final save can outlast a watchdog window
             ckpt.save(state, force=True)
     finally:
         # Any other exception (e.g. a NaN-guard hook aborting) must NOT

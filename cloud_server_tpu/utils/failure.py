@@ -81,11 +81,13 @@ class NaNGuard:
 
 
 class PreemptionHandler:
-    """SIGTERM/SIGINT -> KeyboardInterrupt at the next step boundary.
+    """Preemption signal -> KeyboardInterrupt at the next step boundary.
 
-    Use as a context manager around the train loop; the inner hook only
-    reads a flag, so the signal can arrive at any point (including inside
-    XLA) and the interrupt still lands at a state-consistent boundary.
+    Installs handlers for `signals` (default: SIGTERM only — SIGINT keeps
+    Python's immediate Ctrl-C behaviour unless explicitly listed). Use as
+    a context manager around the train loop; the inner hook only reads a
+    flag, so the signal can arrive at any point (including inside XLA) and
+    the interrupt still lands at a state-consistent boundary.
     """
 
     def __init__(self, signals=(signal.SIGTERM,)):

@@ -1,0 +1,140 @@
+"""Continuous-batching server: parity with engine.generate, interleaving,
+EOS, streaming, background thread."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from cloud_server_tpu.config import InferConfig, ModelConfig
+from cloud_server_tpu.inference import engine
+from cloud_server_tpu.inference.server import InferenceServer
+from cloud_server_tpu.models import transformer
+
+CFG = ModelConfig(
+    vocab_size=64, embed_dim=32, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=8, mlp_dim=64, max_seq_len=128, dtype="float32",
+    param_dtype="float32", remat="none")
+GREEDY = InferConfig(max_decode_len=8, temperature=0.0, eos_token_id=-1,
+                     pad_token_id=0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+def _engine_reference(params, prompt: list[int], n_new: int) -> list[int]:
+    """Greedy per-prompt reference from the batch engine."""
+    icfg = dataclasses.replace(GREEDY, max_decode_len=n_new)
+    toks = engine.generate(
+        params, np.asarray([prompt], np.int32), jax.random.key(1),
+        cfg=CFG, infer_cfg=icfg)
+    return list(np.asarray(toks)[0])
+
+
+PROMPTS = [[5, 9, 3], [17, 2, 40, 8, 21], [60], [1, 2, 3, 4, 5, 6, 7, 8, 9]]
+
+
+def test_server_matches_engine_greedy(params):
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=4, max_len=64,
+                          prompt_buckets=[16])
+    outs = srv.generate(PROMPTS, max_new_tokens=8)
+    for prompt, out in zip(PROMPTS, outs):
+        assert out == _engine_reference(params, prompt, 8), prompt
+
+
+def test_continuous_batching_interleaves(params):
+    """Requests submitted mid-flight join running decodes and still match."""
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=2, max_len=64,
+                          prompt_buckets=[16])
+    r0 = srv.submit(PROMPTS[0], max_new_tokens=12)
+    for _ in range(3):
+        srv.step()
+    # join while r0 is mid-decode; only 2 slots, so r2 queues behind
+    r1 = srv.submit(PROMPTS[1], max_new_tokens=6)
+    r2 = srv.submit(PROMPTS[2], max_new_tokens=6)
+    assert srv.num_pending >= 1
+    srv.run_until_idle()
+    assert r0.result() == _engine_reference(params, PROMPTS[0], 12)
+    assert r1.result() == _engine_reference(params, PROMPTS[1], 6)
+    assert r2.result() == _engine_reference(params, PROMPTS[2], 6)
+    assert r0.finish_reason == r1.finish_reason == "length"
+
+
+def test_eos_stops_early_and_frees_slot(params):
+    ref = _engine_reference(params, PROMPTS[0], 12)
+    # pick an EOS that first appears mid-stream (greedy decode repeats
+    # tokens, so an arbitrary index could alias an earlier token)
+    cut = next(i for i in range(1, len(ref)) if ref[i] not in ref[:i])
+    icfg = dataclasses.replace(GREEDY, eos_token_id=ref[cut])
+    srv = InferenceServer(params, CFG, icfg, max_slots=1, max_len=64,
+                          prompt_buckets=[16])
+    req = srv.submit(PROMPTS[0], max_new_tokens=12)
+    srv.run_until_idle()
+    assert req.finish_reason == "eos"
+    assert req.tokens == ref[:cut]  # everything before EOS, EOS excluded
+    assert srv.num_active == 0
+
+
+def test_streaming_callback_sees_tokens_in_order(params):
+    seen = []
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=1, max_len=64,
+                          prompt_buckets=[16])
+    req = srv.submit(PROMPTS[0], max_new_tokens=8, stream=seen.append)
+    srv.run_until_idle()
+    assert seen == req.tokens == _engine_reference(params, PROMPTS[0], 8)
+
+
+def test_background_thread_serving(params):
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=2, max_len=64,
+                          prompt_buckets=[16]).start()
+    try:
+        reqs = [srv.submit(p, max_new_tokens=6) for p in PROMPTS]
+        outs = [r.result(timeout=120) for r in reqs]
+    finally:
+        srv.stop()
+    for prompt, out in zip(PROMPTS, outs):
+        assert out == _engine_reference(params, prompt, 6), prompt
+
+
+def test_submit_validation(params):
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=1, max_len=16,
+                          prompt_buckets=[8])
+    with pytest.raises(ValueError):
+        srv.submit([])
+    with pytest.raises(ValueError):
+        srv.submit(list(range(9)))  # exceeds largest bucket
+    with pytest.raises(ValueError):
+        srv.submit(list(range(8)), max_new_tokens=0)  # nothing to decode
+
+
+def test_scheduler_error_unblocks_clients(params):
+    """A fatal step() error must fail waiting requests, not hang them."""
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=1, max_len=64,
+                          prompt_buckets=[16])
+    srv.step = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    srv.start()
+    try:
+        req = srv.submit(PROMPTS[0], max_new_tokens=4)
+        with pytest.raises(RuntimeError, match="boom"):
+            req.result(timeout=60)
+    finally:
+        srv.stop()
+
+
+def test_bucket_validation_at_init(params):
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        InferenceServer(params, CFG, GREEDY, max_slots=1, max_len=32,
+                        prompt_buckets=[64])
+
+
+def test_slot_reuse_no_leakage(params):
+    """A slot freed by one request must serve the next one exactly."""
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=1, max_len=64,
+                          prompt_buckets=[16])
+    first = srv.generate([PROMPTS[1]], max_new_tokens=10)[0]
+    second = srv.generate([PROMPTS[2]], max_new_tokens=10)[0]
+    assert first == _engine_reference(params, PROMPTS[1], 10)
+    assert second == _engine_reference(params, PROMPTS[2], 10)
