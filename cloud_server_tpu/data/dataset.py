@@ -32,10 +32,18 @@ class MemmapTokenDataset:
     for sp-sharded attention.
     """
 
-    def __init__(self, path: str | os.PathLike, seq_len: int,
-                 dtype=np.uint16):
+    def __init__(self, path: str | os.PathLike, seq_len: int, dtype=None):
+        """dtype None => auto-detect from the `<path>.meta.json` sidecar
+        written by `tokenizer.prepare_corpus`, falling back to uint16."""
         self.path = os.fspath(path)
         self.seq_len = seq_len
+        if dtype is None:
+            dtype = np.uint16
+            meta_path = self.path + ".meta.json"
+            if os.path.exists(meta_path):
+                import json
+                with open(meta_path) as f:
+                    dtype = np.dtype(json.load(f)["dtype"])
         self._tokens = np.memmap(self.path, dtype=dtype, mode="r")
         n = len(self._tokens) // seq_len
         if n <= 0:

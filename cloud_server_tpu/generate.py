@@ -1,0 +1,122 @@
+"""Text generation CLI: `python -m cloud_server_tpu.generate`.
+
+Loads model params from a training checkpoint (or random-inits for smoke
+runs), tokenizes prompts, and serves them through the continuous-batching
+`InferenceServer`. The tokenizer is byte-level by default or a local
+HuggingFace `tokenizer.json` via `--tokenizer`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m cloud_server_tpu.generate",
+        description="Generate text from a trained checkpoint.")
+    p.add_argument("--config", help="JSON config with the model section "
+                   "used at training time")
+    p.add_argument("--checkpoint-dir",
+                   help="training checkpoint directory (omit: random init)")
+    p.add_argument("--step", type=int, help="checkpoint step (default latest)")
+    p.add_argument("--tokenizer", default="byte",
+                   help='"byte" or a local tokenizer.json path')
+    p.add_argument("--prompt", action="append", default=[],
+                   help="prompt text (repeatable); '-' reads lines from stdin")
+    p.add_argument("--max-new", type=int, default=128)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-len", type=int, default=0,
+                   help="server cache length (default: fits prompt+max-new)")
+    p.add_argument("--add-bos", action="store_true",
+                   help="prepend BOS to prompts (only if training data "
+                   "contained BOS — prepare_corpus does not emit it)")
+    return p
+
+
+def load_params(model_cfg, checkpoint_dir: str | None, step: int | None,
+                seed: int):
+    import jax
+
+    from cloud_server_tpu.config import TrainConfig
+    from cloud_server_tpu.models import transformer
+    from cloud_server_tpu.parallel.mesh import make_mesh
+    from cloud_server_tpu.config import MeshConfig
+
+    if checkpoint_dir is None:
+        print("[generate] no --checkpoint-dir; using random init",
+              file=sys.stderr)
+        return transformer.init_params(model_cfg, jax.random.key(seed))
+
+    from cloud_server_tpu.training.checkpoint import (
+        Checkpointer, abstract_train_state)
+    mesh = make_mesh(MeshConfig())
+    # the optimizer pytree structure is TrainConfig-independent, so a
+    # default TrainConfig reconstructs the saved TrainState's shape
+    target = abstract_train_state(model_cfg, TrainConfig(), mesh)
+    with Checkpointer(checkpoint_dir) as ckpt:
+        state = ckpt.restore(target, step=step)
+    return state.params
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+
+    from cloud_server_tpu.config import InferConfig, ModelConfig, from_json
+    from cloud_server_tpu.data.tokenizer import get_tokenizer
+    from cloud_server_tpu.inference.server import InferenceServer
+
+    raw = {}
+    if args.config:
+        with open(args.config) as f:
+            raw = json.load(f)
+    model_cfg = from_json(ModelConfig, raw.get("model", {}))
+    if model_cfg.num_experts >= 2:
+        raise SystemExit(
+            "the generate CLI serves dense models only; the inference "
+            "engine has no MoE decode path yet (train.py supports MoE "
+            "training, but its checkpoints can't be served here)")
+    tok = get_tokenizer(args.tokenizer)
+    if tok.vocab_size > model_cfg.vocab_size:
+        raise SystemExit(
+            f"tokenizer vocab ({tok.vocab_size}) exceeds model vocab "
+            f"({model_cfg.vocab_size})")
+
+    prompts = []
+    for prm in args.prompt:
+        if prm == "-":
+            prompts.extend(line.rstrip("\n") for line in sys.stdin)
+        else:
+            prompts.append(prm)
+    if not prompts:
+        raise SystemExit("no prompts (use --prompt, repeatable, or '-')")
+
+    params = load_params(model_cfg, args.checkpoint_dir, args.step,
+                         args.seed)
+    encoded = [tok.encode(p, add_bos=args.add_bos and tok.bos_id is not None)
+               or [0] for p in prompts]
+    longest = max(len(e) for e in encoded)
+    max_len = args.max_len or min(model_cfg.max_seq_len,
+                                  longest + args.max_new)
+    infer_cfg = InferConfig(
+        max_decode_len=args.max_new, temperature=args.temperature,
+        top_k=args.top_k, top_p=args.top_p,
+        eos_token_id=tok.eos_id if tok.eos_id is not None else -1,
+        pad_token_id=tok.pad_id or 0)
+
+    srv = InferenceServer(params, model_cfg, infer_cfg,
+                          max_slots=min(8, len(encoded)), max_len=max_len,
+                          seed=args.seed)
+    outs = srv.generate(encoded, max_new_tokens=args.max_new)
+    for prompt, out in zip(prompts, outs):
+        print(f"=== {prompt!r}")
+        print(tok.decode(out))
+
+
+if __name__ == "__main__":
+    main()

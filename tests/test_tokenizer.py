@@ -1,0 +1,117 @@
+"""Tokenizers + corpus preparation."""
+
+import numpy as np
+import pytest
+
+from cloud_server_tpu.data.dataset import MemmapTokenDataset
+from cloud_server_tpu.data.tokenizer import (
+    ByteTokenizer, HFTokenizer, get_tokenizer, prepare_corpus, token_dtype)
+
+
+def test_byte_roundtrip_unicode():
+    tok = ByteTokenizer()
+    text = "hello wörld — 日本語 🚀"
+    assert tok.decode(tok.encode(text)) == text
+
+
+def test_byte_specials():
+    tok = ByteTokenizer()
+    ids = tok.encode("ab", add_bos=True, add_eos=True)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    assert tok.decode(ids) == "ab"  # specials dropped on decode
+    assert tok.vocab_size == 259
+
+
+def test_get_tokenizer_dispatch(tmp_path):
+    assert isinstance(get_tokenizer("byte"), ByteTokenizer)
+    with pytest.raises(FileNotFoundError):
+        get_tokenizer(tmp_path / "missing")
+
+
+def test_token_dtype_boundaries():
+    assert token_dtype(259) == np.uint16
+    assert token_dtype(0xFFFF) == np.uint16
+    assert token_dtype(0x10000) == np.uint32
+
+
+def test_prepare_corpus_matches_one_shot_and_feeds_dataset(tmp_path):
+    text = "\n".join(f"line {i} with some text" for i in range(200)) + "\n"
+    src = tmp_path / "corpus.txt"
+    src.write_text(text)
+    out = tmp_path / "tokens.bin"
+    tok = ByteTokenizer()
+    # tiny chunk size forces many chunk boundaries
+    n = prepare_corpus(src, out, tok, chunk_bytes=64)
+    assert n == len(tok.encode(text))
+    stored = np.fromfile(out, token_dtype(tok.vocab_size))
+    np.testing.assert_array_equal(stored, tok.encode(text))
+
+    ds = MemmapTokenDataset(out, seq_len=32)
+    assert len(ds) == n // 32
+    assert tok.decode(ds[0]["tokens"].tolist()).startswith("line 0")
+
+
+def test_uint32_corpus_autodetected_by_dataset(tmp_path):
+    """A large-vocab corpus (uint32) must not be misread as uint16."""
+    class BigVocab(ByteTokenizer):
+        def __init__(self):
+            super().__init__()
+            self.vocab_size = 100_000  # forces uint32 storage
+
+    tok = BigVocab()
+    src = tmp_path / "c.txt"
+    src.write_text("abcdefgh\n" * 32)
+    out = tmp_path / "c.bin"
+    n = prepare_corpus(src, out, tok)
+    assert token_dtype(tok.vocab_size) == np.uint32
+    ds = MemmapTokenDataset(out, seq_len=16)  # dtype auto from sidecar
+    assert len(ds) == n // 16
+    assert tok.decode(ds[0]["tokens"].tolist()).startswith("abcdefgh")
+
+
+def test_tokenizer_cli(tmp_path, capsys):
+    from cloud_server_tpu.data.tokenizer import main
+    src = tmp_path / "in.txt"
+    src.write_text("abc\ndef\n")
+    main([str(src), str(tmp_path / "out.bin")])
+    assert "8 tokens" in capsys.readouterr().out
+
+
+@pytest.fixture(scope="module")
+def hf_tokenizer_path(tmp_path_factory):
+    """Train a tiny local BPE so the HF path needs no network."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    trainer = trainers.BpeTrainer(
+        vocab_size=200, special_tokens=["<unk>", "<s>", "</s>", "<pad>"])
+    tok.train_from_iterator(
+        ["the quick brown fox jumps over the lazy dog"] * 50, trainer)
+    path = tmp_path_factory.mktemp("hf") / "tokenizer.json"
+    tok.save(str(path))
+    return str(path)
+
+
+def test_hf_tokenizer_local(hf_tokenizer_path):
+    tok = HFTokenizer(hf_tokenizer_path)
+    assert tok.bos_id is not None and tok.eos_id is not None
+    assert tok.pad_id is not None
+    ids = tok.encode("the quick brown fox", add_bos=True, add_eos=True)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    assert "quick" in tok.decode(ids)
+
+
+def test_hf_tokenizer_from_directory(hf_tokenizer_path):
+    import os
+    tok = HFTokenizer(os.path.dirname(hf_tokenizer_path))
+    assert tok.vocab_size > 0
+
+
+def test_hf_prepare_corpus(tmp_path, hf_tokenizer_path):
+    tok = HFTokenizer(hf_tokenizer_path)
+    src = tmp_path / "c.txt"
+    src.write_text("the quick brown fox\n" * 20)
+    n = prepare_corpus(src, tmp_path / "c.bin", tok, chunk_bytes=32)
+    assert n > 0
+    stored = np.fromfile(tmp_path / "c.bin", token_dtype(tok.vocab_size))
+    assert len(stored) == n
