@@ -113,9 +113,11 @@ def token_dtype(vocab_size: int) -> np.dtype:
 
 def _iter_chunks(path: str | os.PathLike,
                  chunk_bytes: int) -> Iterator[str]:
-    """Stream a UTF-8 text file in chunks without splitting lines (so
+    """Stream a UTF-8 text file in chunks, preferring line boundaries (so
     tokenizers with merges spanning a boundary only ever lose cross-LINE
-    merges, which none of the supported formats have)."""
+    merges, which none of the supported formats have). A newline-free
+    stretch longer than 4×chunk_bytes is flushed mid-line anyway — the
+    bounded-memory contract beats boundary purity on single-line corpora."""
     with open(path, encoding="utf-8") as f:
         buf = ""
         while True:
@@ -129,6 +131,9 @@ def _iter_chunks(path: str | os.PathLike,
             if cut:
                 yield buf[:cut]
                 buf = buf[cut:]
+            elif len(buf) >= 4 * chunk_bytes:
+                yield buf
+                buf = ""
 
 
 def prepare_corpus(text_path: str | os.PathLike,

@@ -36,32 +36,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--add-bos", action="store_true",
                    help="prepend BOS to prompts (only if training data "
                    "contained BOS — prepare_corpus does not emit it)")
+    from cloud_server_tpu.models.lora import add_lora_args
+    add_lora_args(p)
     return p
 
 
 def load_params(model_cfg, checkpoint_dir: str | None, step: int | None,
-                seed: int):
+                seed: int, loss_fn_module=None, mesh=None):
+    """Params-only restore (no optimizer-moment IO), sharded onto `mesh`
+    (default: single-device). Random-inits when no checkpoint_dir."""
     import jax
 
-    from cloud_server_tpu.config import TrainConfig
+    from cloud_server_tpu.config import MeshConfig
     from cloud_server_tpu.models import transformer
     from cloud_server_tpu.parallel.mesh import make_mesh
-    from cloud_server_tpu.config import MeshConfig
 
+    if loss_fn_module is None:
+        loss_fn_module = transformer
     if checkpoint_dir is None:
         print("[generate] no --checkpoint-dir; using random init",
               file=sys.stderr)
-        return transformer.init_params(model_cfg, jax.random.key(seed))
+        return loss_fn_module.init_params(model_cfg, jax.random.key(seed))
 
-    from cloud_server_tpu.training.checkpoint import (
-        Checkpointer, abstract_train_state)
-    mesh = make_mesh(MeshConfig())
-    # the optimizer pytree structure is TrainConfig-independent, so a
-    # default TrainConfig reconstructs the saved TrainState's shape
-    target = abstract_train_state(model_cfg, TrainConfig(), mesh)
-    with Checkpointer(checkpoint_dir) as ckpt:
-        state = ckpt.restore(target, step=step)
-    return state.params
+    from cloud_server_tpu.training.checkpoint import restore_params
+    mesh = mesh if mesh is not None else make_mesh(MeshConfig())
+    return restore_params(checkpoint_dir, model_cfg, mesh, step=step,
+                          loss_fn_module=loss_fn_module)
 
 
 def main(argv=None) -> None:
@@ -96,8 +96,29 @@ def main(argv=None) -> None:
     if not prompts:
         raise SystemExit("no prompts (use --prompt, repeatable, or '-')")
 
-    params = load_params(model_cfg, args.checkpoint_dir, args.step,
-                         args.seed)
+    from cloud_server_tpu.models.lora import (
+        export_merged, load_lora_config, lora_config_from_args,
+        make_lora_module)
+    lcfg = lora_config_from_args(args)
+    if args.checkpoint_dir:
+        saved = load_lora_config(args.checkpoint_dir)
+        if saved is not None:
+            # the sidecar written at training time is authoritative: a
+            # mismatched alpha would silently rescale the adapters
+            if lcfg is not None and lcfg != saved:
+                raise SystemExit(
+                    f"--lora-* flags {lcfg} contradict the checkpoint's "
+                    f"recorded LoRA config {saved}; drop the flags (the "
+                    "sidecar is used automatically)")
+            lcfg = saved
+    if lcfg is not None:
+        params = load_params(model_cfg, args.checkpoint_dir, args.step,
+                             args.seed,
+                             loss_fn_module=make_lora_module(lcfg))
+        params = export_merged(params, lcfg)
+    else:
+        params = load_params(model_cfg, args.checkpoint_dir, args.step,
+                             args.seed)
     encoded = [tok.encode(p, add_bos=args.add_bos and tok.bos_id is not None)
                or [0] for p in prompts]
     longest = max(len(e) for e in encoded)

@@ -43,6 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="call jax.distributed.initialize() (multi-host)")
     p.add_argument("--no-nan-guard", action="store_true",
                    help="disable the NaN/inf loss guard")
+    from cloud_server_tpu.models.lora import add_lora_args
+    add_lora_args(p)
+    p.add_argument("--init-from", metavar="CKPT_DIR",
+                   help="load pretrained base params from this training "
+                   "checkpoint (requires --lora-rank)")
     p.add_argument("--watchdog", type=float, default=0.0, metavar="SECONDS",
                    help="abort (with stack dump) if a step makes no "
                    "progress for this long; 0 disables")
@@ -110,6 +115,27 @@ def main(argv=None) -> None:
                     if args.eval_data else None)
 
     loss_fn_module = moe_module if model_cfg.num_experts >= 2 else transformer
+    if args.init_from and not args.lora_rank:
+        raise SystemExit("--init-from currently requires --lora-rank "
+                         "(full-model warm start is not wired up yet)")
+    if args.lora_rank > 0:
+        if model_cfg.num_experts >= 2:
+            raise SystemExit("LoRA supports the dense family only")
+        from cloud_server_tpu.models.lora import (
+            lora_config_from_args, make_lora_module, save_lora_config)
+        from cloud_server_tpu.parallel.mesh import make_mesh
+        lcfg = lora_config_from_args(args)
+        base_params = None
+        if args.init_from:
+            from cloud_server_tpu.generate import load_params
+            # restore onto the run's real mesh — a default single-device
+            # mesh would materialise the full base on one chip
+            base_params = load_params(model_cfg, args.init_from, None,
+                                      train_cfg.seed,
+                                      mesh=make_mesh(mesh_cfg))
+        loss_fn_module = make_lora_module(lcfg, base_params=base_params)
+        if loop_cfg.checkpoint_dir:
+            save_lora_config(loop_cfg.checkpoint_dir, lcfg)
 
     import contextlib
 

@@ -23,8 +23,9 @@ import orbax.checkpoint as ocp
 
 from cloud_server_tpu.config import ModelConfig, TrainConfig
 from cloud_server_tpu.models import transformer
-from cloud_server_tpu.parallel.sharding import DEFAULT_RULES
-from cloud_server_tpu.training.optim import make_optimizer
+from cloud_server_tpu.parallel.sharding import (
+    DEFAULT_RULES, logical_to_sharding)
+from cloud_server_tpu.training.optim import optimizer_for_module
 from cloud_server_tpu.training.train_step import TrainState, state_shardings
 
 
@@ -37,7 +38,7 @@ def abstract_train_state(model_cfg: ModelConfig, train_cfg: TrainConfig,
     read, the attached NamedSharding says *where* each shard lands.
     """
     shardings = state_shardings(model_cfg, mesh, rules, loss_fn_module)
-    opt = make_optimizer(train_cfg)
+    opt = optimizer_for_module(train_cfg, model_cfg, loss_fn_module)
 
     def init_fn(rng):
         params = loss_fn_module.init_params(model_cfg, rng)
@@ -115,6 +116,41 @@ class Checkpointer:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def restore_params(checkpoint_dir: str | os.PathLike, model_cfg: ModelConfig,
+                   mesh, *, step: int | None = None, rules=DEFAULT_RULES,
+                   loss_fn_module=transformer):
+    """Params-only sharded restore — no optimizer-moment IO.
+
+    For serving and fine-tune warm starts: reads just the `params` subtree
+    of a saved TrainState (~1/3 of the checkpoint bytes; Adam's two moment
+    trees are never touched), sharded straight onto `mesh`.
+    """
+    from functools import partial
+
+    directory = os.path.abspath(os.fspath(checkpoint_dir))
+    if step is None:
+        steps = ocp.utils.checkpoint_steps(directory)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint found under {directory}")
+        step = max(steps)
+
+    logical = loss_fn_module.param_logical_axes(model_cfg)
+    shardings = logical_to_sharding(logical, mesh, rules)
+    shapes = jax.eval_shape(partial(loss_fn_module.init_params, model_cfg),
+                            jax.random.key(0))
+    target = {"params": jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes, shardings)}
+    restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+        out = ckptr.restore(
+            os.path.join(directory, str(step), "default"),
+            args=ocp.args.PyTreeRestore(item=target,
+                                        restore_args=restore_args,
+                                        partial_restore=True))
+    return out["params"]
 
 
 def restore_or_init(ckpt: Checkpointer, model_cfg: ModelConfig,

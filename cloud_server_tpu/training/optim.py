@@ -32,8 +32,12 @@ def make_schedule(cfg: TrainConfig) -> optax.Schedule:
     )
 
 
-def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
-    return optax.chain(
+def make_optimizer(cfg: TrainConfig,
+                   param_labels=None) -> optax.GradientTransformation:
+    """param_labels: optional pytree (matching params) of "trainable" /
+    "frozen" strings — frozen params get `set_to_zero` and allocate no
+    moments (the LoRA fine-tuning path; see models/lora.py)."""
+    opt = optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip_norm),
         optax.adamw(
             learning_rate=make_schedule(cfg),
@@ -43,3 +47,17 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
             mask=_decay_mask,
         ),
     )
+    if param_labels is None:
+        return opt
+    return optax.multi_transform(
+        {"trainable": opt, "frozen": optax.set_to_zero()}, param_labels)
+
+
+def optimizer_for_module(train_cfg: TrainConfig, model_cfg, loss_fn_module):
+    """The one place that decides a module's optimizer structure: modules
+    exposing `param_labels(model_cfg)` (e.g. the LoRA wrapper) get the
+    label-masked variant. Everything that must agree on optimizer *state
+    structure* (train step, init, checkpoint targets) goes through here."""
+    labels_fn = getattr(loss_fn_module, "param_labels", None)
+    labels = labels_fn(model_cfg) if labels_fn is not None else None
+    return make_optimizer(train_cfg, param_labels=labels)

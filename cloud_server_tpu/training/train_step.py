@@ -26,7 +26,7 @@ from cloud_server_tpu.config import ModelConfig, TrainConfig
 from cloud_server_tpu.models import transformer
 from cloud_server_tpu.parallel.sharding import (
     DEFAULT_RULES, logical_to_sharding, spec_from_logical)
-from cloud_server_tpu.training.optim import make_optimizer
+from cloud_server_tpu.training.optim import optimizer_for_module
 
 
 class TrainState(NamedTuple):
@@ -45,7 +45,7 @@ def state_shardings(model_cfg: ModelConfig, mesh: Mesh,
     # Optimizer state mirrors params; derive its sharding by matching
     # structure: any leaf of opt_state with the same shape as a param gets
     # the param's sharding, scalars are replicated.
-    opt = make_optimizer(TrainConfig())
+    opt = optimizer_for_module(TrainConfig(), model_cfg, loss_fn_module)
     params_shape = jax.eval_shape(
         partial(loss_fn_module.init_params, model_cfg), jax.random.key(0))
     opt_shape = jax.eval_shape(opt.init, params_shape)
@@ -71,7 +71,7 @@ def init_train_state(model_cfg: ModelConfig, train_cfg: TrainConfig,
     materialises its own shard (init runs under jit with out_shardings)."""
     shardings = state_shardings(model_cfg, mesh, rules,
                                 loss_fn_module=loss_fn_module)
-    opt = make_optimizer(train_cfg)
+    opt = optimizer_for_module(train_cfg, model_cfg, loss_fn_module)
 
     def init_fn(rng):
         params = loss_fn_module.init_params(model_cfg, rng)
@@ -98,7 +98,7 @@ def make_train_step(model_cfg: ModelConfig, train_cfg: TrainConfig,
         if "router_z_coef" in sig:
             kwargs["router_z_coef"] = train_cfg.moe_router_z_coef
         loss_fn = partial(loss_fn_module.next_token_loss, **kwargs)
-    opt = make_optimizer(train_cfg)
+    opt = optimizer_for_module(train_cfg, model_cfg, loss_fn_module)
     shardings = state_shardings(model_cfg, mesh, rules, loss_fn_module)
     batch_spec = spec_from_logical(("batch", None), rules)
     batch_sharding = NamedSharding(mesh, batch_spec)

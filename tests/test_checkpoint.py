@@ -115,3 +115,23 @@ def test_async_save_is_durable_after_wait(tmp_path):
         assert ckpt.latest_step() == 0
         got = ckpt.restore(abstract_train_state(TINY, TCFG, mesh))
     _assert_states_equal(state, got)
+
+
+def test_restore_params_only_sharded(tmp_path, devices8):
+    """Params-only restore: no optimizer IO, lands sharded on a new mesh."""
+    from cloud_server_tpu.training.checkpoint import Checkpointer, restore_params
+
+    mesh = make_mesh(MeshConfig(fsdp=2))
+    state = init_train_state(TINY, TCFG, mesh, jax.random.key(0))
+    with Checkpointer(tmp_path / "ck") as ck:
+        ck.save(state, force=True)
+
+    mesh2 = make_mesh(MeshConfig(fsdp=4, tp=2))
+    params = restore_params(tmp_path / "ck", TINY, mesh2)
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    wq = params["layers"]["wq"]
+    assert next(iter(wq.addressable_shards)).data.shape[1] == TINY.embed_dim // 4
+
+    with pytest.raises(FileNotFoundError):
+        restore_params(tmp_path / "empty", TINY, mesh2)

@@ -115,3 +115,21 @@ def test_hf_prepare_corpus(tmp_path, hf_tokenizer_path):
     assert n > 0
     stored = np.fromfile(tmp_path / "c.bin", token_dtype(tok.vocab_size))
     assert len(stored) == n
+
+
+def test_single_line_corpus_stays_bounded(tmp_path):
+    """No newlines at all: chunking must flush mid-line, not buffer the
+    whole file; the byte tokenizer is split-invariant so output is exact."""
+    from cloud_server_tpu.data.tokenizer import _iter_chunks
+
+    text = "x" * 5000  # one giant line
+    src = tmp_path / "one_line.txt"
+    src.write_text(text)
+    pieces = list(_iter_chunks(src, chunk_bytes=64))
+    assert max(len(p) for p in pieces) <= 4 * 64
+    assert "".join(pieces) == text
+
+    out = tmp_path / "o.bin"
+    tok = ByteTokenizer()
+    n = prepare_corpus(src, out, tok, chunk_bytes=64)
+    assert n == 5000
