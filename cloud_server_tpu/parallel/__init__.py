@@ -1,3 +1,11 @@
+from cloud_server_tpu.parallel.distributed import (  # noqa: F401
+    broadcast_from_primary,
+    global_mesh_config,
+    initialize,
+    is_primary,
+    make_hybrid_mesh,
+    sync_global_devices,
+)
 from cloud_server_tpu.parallel.mesh import make_mesh  # noqa: F401
 from cloud_server_tpu.parallel.sharding import (  # noqa: F401
     ShardingRules,

@@ -1,0 +1,155 @@
+"""Multi-host distributed runtime: initialization, hybrid ICI×DCN meshes,
+and cross-host coordination helpers.
+
+The comm backend is XLA itself: collectives are derived from sharding
+annotations and ride ICI within a slice and DCN across slices — there is
+no hand-written NCCL/MPI layer to manage. What this module adds is the
+*process* plumbing around that:
+
+* `initialize()` — one idempotent entry point over
+  `jax.distributed.initialize`. On TPU pods the coordinator/process
+  topology is autodetected from the environment; explicit args are for
+  CPU/GPU clusters and tests.
+* `make_hybrid_mesh(ici, dcn)` — a mesh whose DCN-crossing axes are the
+  *outer* mesh dims (`mesh_utils.create_hybrid_device_mesh`), so the
+  cheap/chatty collectives (tp/sp psums) stay on ICI and only dp/pp
+  gradient reductions cross the data-center network. On hardware without
+  slice metadata (CPU tests) it falls back to process-granule grouping,
+  preserving the axis semantics.
+* `is_primary()` / `sync_global_devices()` / `broadcast_from_primary()` —
+  the small coordination vocabulary train loops and checkpointers need
+  (process-0-only logging and saving already use these conventions).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+from jax.experimental import mesh_utils, multihost_utils
+from jax.sharding import Mesh
+
+from cloud_server_tpu.config import MeshConfig
+from cloud_server_tpu.parallel.mesh import set_current_mesh
+
+_initialized = False
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None,
+               local_device_ids=None) -> None:
+    """Idempotent `jax.distributed.initialize`.
+
+    On TPU pods call with no args (topology comes from the TPU runtime
+    env). A second call is a no-op rather than an error, so library code
+    can call it defensively.
+    """
+    global _initialized
+    # NOTE: must not touch any backend-initialising JAX API here
+    # (jax.process_count() etc.) — jax.distributed.initialize() has to run
+    # before the XLA backend comes up.
+    if _initialized or jax.distributed.is_initialized():
+        _initialized = True
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+
+
+def num_slices(devices=None) -> int:
+    """Number of ICI-connected slices (1 on a single slice / CPU)."""
+    devices = devices if devices is not None else jax.devices()
+    slice_ids = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    return len(slice_ids)
+
+
+def make_hybrid_mesh(ici: MeshConfig, dcn: MeshConfig,
+                     devices=None) -> Mesh:
+    """Mesh over multiple slices: per-slice axis sizes from `ici`, across-
+    slice sizes from `dcn` (their elementwise product is the global mesh).
+
+    Keep `dcn` to the outer axes (dp, pp) — DCN bandwidth is orders of
+    magnitude below ICI, and only per-step gradient/pipeline transfers
+    tolerate it. The global axis size seen by sharding rules is
+    ici.axis × dcn.axis.
+    """
+    devices = devices if devices is not None else jax.devices()
+    for axis in ("fsdp", "ep", "sp", "tp"):
+        if getattr(dcn, axis) > 1:
+            raise ValueError(
+                f"dcn mesh axis {axis!r} > 1: fsdp/ep/sp/tp collectives are "
+                "per-layer and would serialise on DCN; keep DCN to dp/pp")
+    n = ici.num_devices * dcn.num_devices
+    if n != len(devices):
+        raise ValueError(
+            f"hybrid mesh wants {ici.num_devices}×{dcn.num_devices}={n} "
+            f"devices, got {len(devices)}")
+    ici_shape = tuple(ici.axis_sizes()[a] for a in MeshConfig.AXIS_ORDER)
+    dcn_shape = tuple(dcn.axis_sizes()[a] for a in MeshConfig.AXIS_ORDER)
+    try:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+    except ValueError:
+        # no slice_index attribute (CPU tests, single-slice hardware):
+        # group by process instead; same axis semantics, host = granule
+        try:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices,
+                process_is_granule=True)
+        except ValueError:
+            # single-process CPU fallback: plain reshape keeps the global
+            # shape correct (no physical locality to optimise anyway)
+            shape = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
+            dev_array = np.asarray(devices).reshape(shape)
+    return set_current_mesh(Mesh(dev_array, MeshConfig.AXIS_ORDER))
+
+
+def global_mesh_config(ici: MeshConfig, dcn: MeshConfig) -> MeshConfig:
+    """The MeshConfig equivalent of a hybrid mesh's global shape (what
+    batch-size divisibility checks should be run against)."""
+    sizes = {a: ici.axis_sizes()[a] * dcn.axis_sizes()[a]
+             for a in MeshConfig.AXIS_ORDER}
+    return MeshConfig(**sizes)
+
+
+# -- coordination helpers ----------------------------------------------------
+
+def is_primary() -> bool:
+    return jax.process_index() == 0
+
+
+def sync_global_devices(name: str) -> None:
+    """Barrier across all hosts (no-op single-process)."""
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices(name)
+
+
+def broadcast_from_primary(pytree):
+    """Make process 0's host values authoritative everywhere (e.g. an RNG
+    seed read from a file, a resolved checkpoint step)."""
+    if jax.process_count() <= 1:
+        return pytree
+    return multihost_utils.broadcast_one_to_all(pytree)
+
+
+def process_env_summary() -> dict:
+    """Debug snapshot for launch scripts / failure reports."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "num_slices": num_slices(),
+        "coordinator": os.environ.get("JAX_COORDINATOR_ADDRESS"),
+    }

@@ -55,7 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def configs_from_args(args) -> tuple:
-    """(ModelConfig, TrainConfig, MeshConfig, LoopConfig) from file + flags."""
+    """(ModelConfig, TrainConfig, MeshConfig, LoopConfig, dcn MeshConfig or
+    None) from file + flags. A "dcn_mesh" config section requests a hybrid
+    ICI×DCN mesh (multi-slice training): its axes say how the "mesh"
+    section's layout is replicated across slices."""
     from cloud_server_tpu.config import (
         MeshConfig, ModelConfig, TrainConfig, from_json)
     from cloud_server_tpu.training.loop import LoopConfig
@@ -68,6 +71,8 @@ def configs_from_args(args) -> tuple:
     train_cfg = from_json(TrainConfig, raw.get("train", {}))
     mesh_cfg = from_json(MeshConfig, raw.get("mesh", {}))
     loop_cfg = from_json(LoopConfig, raw.get("loop", {}))
+    dcn_cfg = (from_json(MeshConfig, raw["dcn_mesh"])
+               if "dcn_mesh" in raw else None)
 
     train_over = {k: v for k, v in {
         "total_steps": args.steps, "batch_size": args.batch_size,
@@ -87,21 +92,33 @@ def configs_from_args(args) -> tuple:
               "defaulting loop.eval_interval=500")
     if loop_over:
         loop_cfg = dataclasses.replace(loop_cfg, **loop_over)
-    return model_cfg, train_cfg, mesh_cfg, loop_cfg
+    return model_cfg, train_cfg, mesh_cfg, loop_cfg, dcn_cfg
 
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     if args.distributed:
-        import jax
-        jax.distributed.initialize()
+        from cloud_server_tpu.parallel.distributed import initialize
+        initialize()
 
     from cloud_server_tpu.data.dataset import (
         MemmapTokenDataset, SyntheticLMDataset)
     from cloud_server_tpu.models import moe as moe_module, transformer
     from cloud_server_tpu.training.loop import train_loop
 
-    model_cfg, train_cfg, mesh_cfg, loop_cfg = configs_from_args(args)
+    model_cfg, train_cfg, mesh_cfg, loop_cfg, dcn_cfg = configs_from_args(args)
+    mesh = None
+    if dcn_cfg is not None:
+        from cloud_server_tpu.parallel.distributed import (
+            global_mesh_config, make_hybrid_mesh)
+        g = global_mesh_config(mesh_cfg, dcn_cfg)
+        batch_shards = g.dp * g.fsdp
+        if train_cfg.batch_size % batch_shards:
+            raise SystemExit(
+                f"batch_size {train_cfg.batch_size} not divisible by the "
+                f"GLOBAL batch-sharding axes dp×fsdp = {g.dp}×{g.fsdp} = "
+                f"{batch_shards} (mesh × dcn_mesh)")
+        mesh = make_hybrid_mesh(mesh_cfg, dcn_cfg)
 
     if args.synthetic:
         dataset = SyntheticLMDataset(args.synthetic, train_cfg.seq_len,
@@ -130,12 +147,14 @@ def main(argv=None) -> None:
             from cloud_server_tpu.generate import load_params
             # restore onto the run's real mesh — a default single-device
             # mesh would materialise the full base on one chip
-            base_params = load_params(model_cfg, args.init_from, None,
-                                      train_cfg.seed,
-                                      mesh=make_mesh(mesh_cfg))
+            base_params = load_params(
+                model_cfg, args.init_from, None, train_cfg.seed,
+                mesh=mesh if mesh is not None else make_mesh(mesh_cfg))
         loss_fn_module = make_lora_module(lcfg, base_params=base_params)
         if loop_cfg.checkpoint_dir:
-            save_lora_config(loop_cfg.checkpoint_dir, lcfg)
+            from cloud_server_tpu.parallel.distributed import is_primary
+            if is_primary():  # shared ckpt dir: N writers would race
+                save_lora_config(loop_cfg.checkpoint_dir, lcfg)
 
     import contextlib
 
@@ -152,7 +171,7 @@ def main(argv=None) -> None:
             hooks.append(stack.enter_context(Watchdog(args.watchdog)))
         train_loop(model_cfg, train_cfg, dataset, mesh_cfg=mesh_cfg,
                    loop_cfg=loop_cfg, eval_dataset=eval_dataset,
-                   loss_fn_module=loss_fn_module, hooks=hooks)
+                   loss_fn_module=loss_fn_module, hooks=hooks, mesh=mesh)
 
 
 if __name__ == "__main__":

@@ -73,7 +73,8 @@ def train_loop(model_cfg: ModelConfig, train_cfg: TrainConfig,
                dataset, *, mesh_cfg: MeshConfig | None = None,
                loop_cfg: LoopConfig | None = None, eval_dataset=None,
                rules=None, loss_fn_module=transformer, loss_fn=None,
-               hooks: Sequence[Hook] = (), max_steps: int | None = None):
+               hooks: Sequence[Hook] = (), max_steps: int | None = None,
+               mesh=None):
     """Run training to `train_cfg.total_steps`; returns the final TrainState.
 
     Resumes automatically from `loop_cfg.checkpoint_dir` when a checkpoint
@@ -84,7 +85,9 @@ def train_loop(model_cfg: ModelConfig, train_cfg: TrainConfig,
     """
     loop_cfg = loop_cfg or LoopConfig()
     rules = rules or DEFAULT_RULES
-    mesh = make_mesh(mesh_cfg or MeshConfig())
+    # an explicit mesh (e.g. a hybrid ICI×DCN mesh from
+    # parallel.distributed.make_hybrid_mesh) takes precedence over mesh_cfg
+    mesh = mesh if mesh is not None else make_mesh(mesh_cfg or MeshConfig())
 
     step_fn, batch_sharding = make_train_step(
         model_cfg, train_cfg, mesh, rules=rules, loss_fn=loss_fn,

@@ -95,3 +95,20 @@ def test_cli_synthetic_and_memmap(tmp_path, devices8):
           "--eval-data", str(tmp_path / "tokens.bin"),
           "--steps", "2", "--logdir", str(tmp_path / "logs2")])
     assert os.path.exists(tmp_path / "logs2" / "train.jsonl")
+
+
+def test_cli_hybrid_dcn_mesh(tmp_path, devices8):
+    """A config with a dcn_mesh section trains over the hybrid mesh."""
+    import os
+
+    from cloud_server_tpu.train import main
+
+    cfg = {"model": {**TINY.__dict__},
+           "train": {**TCFG.__dict__, "total_steps": 2},
+           "mesh": {"fsdp": 2, "tp": 2},
+           "dcn_mesh": {"dp": 2},
+           "loop": {"log_interval": 1}}
+    (tmp_path / "cfg.json").write_text(json.dumps(cfg))
+    main(["--config", str(tmp_path / "cfg.json"), "--synthetic", "64",
+          "--logdir", str(tmp_path / "logs")])
+    assert os.path.exists(tmp_path / "logs" / "train.jsonl")
