@@ -31,6 +31,8 @@ class ModelConfig:
     param_dtype: str = "float32"  # master parameter dtype
     # attention implementation: "xla" | "flash" | "ring"
     attention_impl: str = "xla"
+    # decode-time (cached, single-query) attention: "xla" | "pallas"
+    decode_attention_impl: str = "xla"
     # mixture of experts (0 experts => dense MLP)
     num_experts: int = 0
     num_experts_per_token: int = 2

@@ -120,16 +120,27 @@ def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
 
     x = params["embed"]["tokens"].astype(cfg.dtype)[token[:, None]]  # (B,1,D)
 
+    if cfg.decode_attention_impl == "pallas":
+        from cloud_server_tpu.ops.decode_attention import decode_attention
+
+        def attend(q, k_cache, v_cache):
+            return decode_attention(q, k_cache, v_cache, cache.length + 1)
+    elif cfg.decode_attention_impl == "xla":
+        def attend(q, k_cache, v_cache):
+            return causal_attention(q, k_cache, v_cache,
+                                    q_positions=positions,
+                                    kv_length=cache.length + 1)
+    else:
+        raise ValueError(
+            f"unknown decode_attention_impl: {cfg.decode_attention_impl!r}")
+
     def scan_body(carry, layer):
         x = carry
         lp, k_cache, v_cache = layer
         q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin, positions)
         k_cache = _update_at(k_cache, k, pos)
         v_cache = _update_at(v_cache, v, pos)
-        o = causal_attention(
-            q, k_cache, v_cache,
-            q_positions=positions,
-            kv_length=cache.length + 1)
+        o = attend(q, k_cache, v_cache)
         x = transformer.attention_out(x, o, lp, cfg)
         x = transformer.mlp_block(x, lp, cfg)
         return x, (k_cache, v_cache)
