@@ -40,17 +40,21 @@ def main() -> None:
                            model_cfg.vocab_size), batch_sharding)
     data = {"tokens": tokens}
 
-    # Warmup / compile.
+    # Warmup / compile. float() forces a device->host transfer, which is a
+    # true sync even on backends where block_until_ready returns early
+    # (observed on the tunneled 'axon' platform).
     for _ in range(3):
         state, metrics = step(state, data)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
 
     n_steps = 20
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = step(state, data)
-    jax.block_until_ready(metrics["loss"])
+    loss_val = float(metrics["loss"])
     dt = time.perf_counter() - t0
+    if loss_val != loss_val:
+        raise SystemExit("bench invalid: loss is NaN")
 
     tokens_per_sec = batch * seq * n_steps / dt
 

@@ -87,10 +87,12 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _default_block(seq: int, want: int) -> int:
-    b = min(seq, want)
-    while seq % b:
-        b //= 2
-    return max(b, 1)
+    # block_s need not divide seq: the grid uses cdiv and the boundary
+    # block is padded by pallas, with padded rows masked by the kv_pos <
+    # length guard in the kernel (padded kv_pos >= seq >= length always).
+    # Requiring divisibility here would collapse block_s to 1 for odd cache
+    # lengths (e.g. prompt 1000 + 25 new tokens), an enormous perf cliff.
+    return min(seq, want)
 
 
 def decode_attention(q, k_cache, v_cache, lengths, *, scale=None,

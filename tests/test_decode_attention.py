@@ -89,3 +89,18 @@ def test_rejects_multi_query():
     q, k, v, lengths = _case()
     with pytest.raises(AssertionError):
         decode_attention(jnp.concatenate([q, q], axis=1), k, v, lengths)
+
+
+@pytest.mark.parametrize("s,block_s", [(72, 32), (1025, 512), (65, 64)])
+def test_non_divisible_cache_length(s, block_s):
+    """block_s need not divide S: boundary blocks are padded + masked.
+
+    Regression for the perf cliff where odd cache lengths (e.g. prompt 1000
+    + 25 new tokens => S=1025) collapsed block_s to 1."""
+    from cloud_server_tpu.ops.decode_attention import _default_block
+    assert _default_block(1025, 512) == 512
+    q, k, v, lengths = _case(s=s)
+    out = decode_attention(q, k, v, lengths, block_s=block_s)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_reference(q, k, v, lengths)),
+                               rtol=2e-5, atol=2e-5)

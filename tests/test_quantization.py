@@ -1,0 +1,129 @@
+"""Weight-only int8 quantization: numerics, model pass-through, MoE, jit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cloud_server_tpu.config import InferConfig, ModelConfig
+from cloud_server_tpu.inference import generate
+from cloud_server_tpu.models import moe, transformer
+from cloud_server_tpu.models.quantization import (
+    QTensor, dequantize_params, quantize, quantize_params, quantized_bytes)
+
+TINY = ModelConfig(
+    vocab_size=64, embed_dim=32, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=8, mlp_dim=64, max_seq_len=64, dtype="float32",
+    param_dtype="float32", remat="none")
+
+
+def _params():
+    return transformer.init_params(TINY, jax.random.key(0))
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.key(0), (4, 16, 8))
+    qt = quantize(w, (1,))
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (4, 1, 8)
+    # per-channel symmetric int8: error <= scale/2 elementwise
+    err = np.abs(np.asarray(qt.dequantize() - w))
+    bound = np.asarray(qt.scale) / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+def test_quantize_params_selects_weights_only():
+    params = quantize_params(_params())
+    layers = params["layers"]
+    for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        assert isinstance(layers[name], QTensor), name
+    assert isinstance(layers["attn_norm"], jnp.ndarray)
+    assert isinstance(params["embed"]["tokens"], jnp.ndarray)
+    assert isinstance(params["lm_head"]["kernel"], QTensor)
+    stored, bf16 = quantized_bytes(params)
+    assert stored < 0.75 * bf16  # real footprint win
+
+
+def test_scale_constant_along_contraction_axes():
+    params = quantize_params(_params())
+    layers = params["layers"]
+    # (L, D, H, Dh): D contracted -> scale broadcasts over D
+    assert layers["wq"].scale.shape[1] == 1
+    # (L, H, Dh, D): H, Dh contracted
+    assert layers["wo"].scale.shape[1:3] == (1, 1)
+
+
+def test_quantized_forward_close_to_fp():
+    params = _params()
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, TINY.vocab_size)
+    ref = transformer.forward(params, tokens, TINY)
+    got = transformer.forward(quantize_params(params), tokens, TINY)
+    # int8 per-channel on a 2-layer model: logits should agree closely and
+    # the argmax (greedy token choice) should almost always match.
+    agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+    assert float(agree) > 0.9
+    ref_n = np.asarray(ref).ravel()
+    got_n = np.asarray(got).ravel()
+    cos = np.dot(ref_n, got_n) / (
+        np.linalg.norm(ref_n) * np.linalg.norm(got_n))
+    assert cos > 0.999
+
+
+def test_quantized_generate_under_jit():
+    """QTensor leaves must flow through jit + lax.scan layer stacking."""
+    qparams = quantize_params(_params())
+    prompt = jax.random.randint(jax.random.key(2), (2, 4), 0, TINY.vocab_size)
+    icfg = InferConfig(max_decode_len=6, temperature=0.0)
+    out = generate(qparams, prompt, jax.random.key(0), cfg=TINY,
+                   infer_cfg=icfg)
+    assert out.shape == (2, 6)
+    assert (np.asarray(out) >= 0).all()
+
+
+def test_moe_params_quantize():
+    cfg = ModelConfig(
+        vocab_size=64, embed_dim=32, num_layers=2, num_heads=4,
+        num_kv_heads=4, head_dim=8, mlp_dim=64, max_seq_len=64,
+        num_experts=4, dtype="float32", param_dtype="float32", remat="none")
+    params = moe.init_params(cfg, jax.random.key(0))
+    qparams = quantize_params(params)
+    layers = qparams["layers"]
+    assert isinstance(layers["w_gate"], QTensor)
+    assert layers["w_gate"].scale.shape[2] == 1  # (L, E, D, F): D contracted
+    assert isinstance(layers["router"], jnp.ndarray)  # router stays fp
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size)
+    ref, _ = moe.forward(params, tokens, cfg)
+    got, _ = moe.forward(qparams, tokens, cfg)
+    ref_n, got_n = np.asarray(ref).ravel(), np.asarray(got).ravel()
+    cos = np.dot(ref_n, got_n) / (
+        np.linalg.norm(ref_n) * np.linalg.norm(got_n))
+    assert cos > 0.99
+
+
+def test_quantized_sharded_forward(devices8):
+    """int8 params device_put onto a fsdp×tp mesh must match unsharded."""
+    from jax.sharding import Mesh
+
+    from cloud_server_tpu.models.quantization import quantized_shardings
+
+    params = _params()
+    qp = quantize_params(params)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                TINY.vocab_size)
+    ref = transformer.forward(qp, tokens, TINY)
+
+    mesh = Mesh(np.array(devices8).reshape(4, 2), ("fsdp", "tp"))
+    shardings = quantized_shardings(qp, transformer.param_logical_axes(TINY),
+                                    mesh)
+    qp_sharded = jax.device_put(qp, shardings)
+    got = jax.jit(transformer.forward, static_argnums=2)(
+        qp_sharded, tokens, TINY)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4)
+
+
+def test_dequantize_params_roundtrip():
+    params = _params()
+    deq = dequantize_params(quantize_params(params))
+    assert isinstance(deq["layers"]["wq"], jnp.ndarray)
+    err = float(jnp.max(jnp.abs(deq["layers"]["wq"]
+                                - params["layers"]["wq"])))
+    assert err < 0.05
