@@ -29,6 +29,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# LSE is logically (B, H, S); it is stored rank-4 as (B, H, S, LSE_LANES).
+# A rank-3 (1, 1, block_q) block spec does not lower on TPU (Mosaic needs
+# the last two block dims (8, 128)-tileable *or* equal to the array dims).
+# With LSE_LANES=1 the trailing block dim equals the array dim, which is
+# legal, and HBM storage/traffic stays 1 lane instead of a 128x broadcast.
+LSE_LANES = 1
 
 
 def _default_block(seq: int, want: int) -> int:
@@ -85,7 +91,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = l_ref[:, :1]
         o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_ref[:, :1] + jnp.log(l))[:, 0]
+        # LSE is logically (bq,) but stored lane-padded as (bq, 128):
+        # Mosaic requires the last two block dims to be (8,128)-tileable,
+        # so a rank-3 (1, 1, bq) block spec does not lower on TPU.
+        lse_ref[0, 0] = jnp.broadcast_to(m_ref[:, :1] + jnp.log(l),
+                                         lse_ref.shape[2:])
 
 
 def _fwd(q, k, v, *, scale, block_q, block_kv, interpret):
@@ -107,11 +117,12 @@ def _fwd(q, k, v, *, scale, block_q, block_kv, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, i, j: (bi, hi, i)),
+            pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                         lambda bi, hi, i, j: (bi, hi, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
             _vmem((block_q, d), jnp.float32),
@@ -148,7 +159,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
         v = v_ref[0, 0].astype(jnp.float32)
         o = o_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]  # (bq, 1)
+        lse = lse_ref[0, 0][:, :1]  # lane-padded (bq, LSE_LANES) -> (bq, 1)
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -189,7 +200,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
         v = v_ref[0, 0].astype(jnp.float32)
         o = o_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
+        lse = lse_ref[0, 0][:, :1]  # lane-padded (bq, LSE_LANES) -> (bq, 1)
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -239,7 +250,8 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
     q_spec_qs = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0))
     kv_spec_qs = pl.BlockSpec((1, 1, block_kv, d),
                               lambda bi, hi, i, j: (bi, hi // g, j, 0))
-    lse_spec_qs = pl.BlockSpec((1, 1, block_q), lambda bi, hi, i, j: (bi, hi, i))
+    lse_spec_qs = pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                               lambda bi, hi, i, j: (bi, hi, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
@@ -258,7 +270,8 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
     q_spec_ks = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, j, i: (bi, hi, i, 0))
     kv_spec_ks = pl.BlockSpec((1, 1, block_kv, d),
                               lambda bi, hi, j, i: (bi, hi // g, j, 0))
-    lse_spec_ks = pl.BlockSpec((1, 1, block_q), lambda bi, hi, j, i: (bi, hi, i))
+    lse_spec_ks = pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                               lambda bi, hi, j, i: (bi, hi, i, 0))
     dkv_out_spec = pl.BlockSpec((1, 1, block_kv, d),
                                 lambda bi, hi, j, i: (bi, hi, j, 0))
 

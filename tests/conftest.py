@@ -6,11 +6,18 @@ the real TPU is only used by bench.py.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+# CST_TPU_TESTS=1 keeps the real backend so skipif-gated on-chip tests run,
+# e.g.: CST_TPU_TESTS=1 python -m pytest tests/ -k "compiled_on_tpu".
+# Run only TPU-gated tests this way — the rest of the suite assumes the
+# 8-device virtual CPU mesh. Default (unset): virtual CPU platform.
+_USE_TPU = os.environ.get("CST_TPU_TESTS") == "1"
+
+if not _USE_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
@@ -18,7 +25,8 @@ import pytest  # noqa: E402
 # A sitecustomize on this image may import jax and register the TPU plugin
 # before conftest runs, making the env vars above too late. The config
 # update still wins as long as no backend has been initialized yet.
-jax.config.update("jax_platforms", "cpu")
+if not _USE_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_threefry_partitionable", True)
 # This JAX build defaults matmuls to bf16-style passes even on CPU; tests

@@ -66,3 +66,29 @@ def test_backward_gqa():
     for a, b, name in zip(gf, gd, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
                                    rtol=1e-3, err_msg=f"d{name}")
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="compiled-mode Mosaic lowering needs a real TPU")
+def test_compiled_on_tpu():
+    """Regression guard for Mosaic lowering: r1's (1, 1, block_q) LSE block
+    spec failed to lower on-chip while every interpret-mode test passed."""
+    q, k, v = _rand_qkv(4, 2, 512, 8, 4, 64)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    got = jax.jit(flash_attention)(q, k, v)
+    want = causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), atol=3e-2)
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v).astype(jnp.float32) ** 2).sum()
+
+    def f_dense(q, k, v):
+        return (causal_attention(q, k, v).astype(jnp.float32) ** 2).sum()
+
+    gf = jax.jit(jax.grad(f_flash, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.jit(jax.grad(f_dense, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=0.15,
+                                   err_msg=f"d{name}")
