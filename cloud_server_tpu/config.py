@@ -40,6 +40,12 @@ class ModelConfig:
     # rematerialisation policy for the layer scan: "none" | "full" | "dots"
     remat: str = "full"
     logits_softcap: float = 0.0
+    # Training-loss vocab chunk size. 0 = dense path (materialise the full
+    # (B, S, V) f32 logits). >0 = fused blockwise CE: the unembed matmul,
+    # softcap and logsumexp run one vocab chunk at a time inside a
+    # rematerialised scan, so peak loss-path memory is (B, S, chunk) and
+    # the ~1 GB logits tensor never hits HBM.
+    vocab_chunk: int = 0
 
     def __post_init__(self) -> None:
         if self.num_heads % max(self.num_kv_heads, 1) != 0:

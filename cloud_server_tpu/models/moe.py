@@ -182,8 +182,8 @@ def _moe_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn):
     return x + out, aux
 
 
-def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
-    """(B, S) -> (logits (B, S, V) f32, aux dict of scalar router stats)."""
+def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
+    """(B, S) -> (final-normed hidden (B, S, D), aux dict of router stats)."""
     cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1], cfg.rope_theta)
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
     attn_fn = transformer._get_attention_fn(cfg)
@@ -202,21 +202,28 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
     (x, lb, rz, dropped), _ = lax.scan(
         scan_body, (x, zero, zero, zero), params["layers"])
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
-    head = (params["embed"]["tokens"].T if cfg.tie_embeddings
-            else params["lm_head"]["kernel"])
-    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
-    logits = transformer.apply_logits_softcap(logits, cfg)
     n = cfg.num_layers
     aux = {"load_balance": lb / n, "router_z": rz / n, "dropped_frac": dropped / n}
-    return logits, aux
+    return x, aux
+
+
+def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
+    """(B, S) -> (logits (B, S, V) f32, aux dict of scalar router stats)."""
+    x, aux = forward_hidden(params, tokens, cfg)
+    return transformer.unembed(x, params, cfg), aux
 
 
 def next_token_loss(params: Params, batch: dict, cfg: ModelConfig,
                     z_loss_coef: float = 0.0, aux_loss_coef: float = 0.01,
                     router_z_coef: float = 0.0):
-    logits, aux = forward(params, batch["tokens"], cfg)
-    loss, metrics = transformer.masked_cross_entropy(logits, batch, z_loss_coef)
+    if cfg.vocab_chunk > 0:
+        x, aux = forward_hidden(params, batch["tokens"], cfg)
+        loss, metrics = transformer.fused_cross_entropy(
+            x, params, batch, cfg, z_loss_coef)
+    else:
+        logits, aux = forward(params, batch["tokens"], cfg)
+        loss, metrics = transformer.masked_cross_entropy(
+            logits, batch, z_loss_coef)
     metrics.update(load_balance=aux["load_balance"],
                    router_z=aux["router_z"],
                    dropped_frac=aux["dropped_frac"])

@@ -26,6 +26,8 @@ from cloud_server_tpu.ops import apply_rope, causal_attention, rms_norm, rope_fr
 
 Params = dict
 
+NEG_INF = -1e30  # finite stand-in for -inf (keeps exp/where NaN-free)
+
 
 # ---------------------------------------------------------------------------
 # Init
@@ -143,10 +145,14 @@ def mlp_block(x, lp, cfg: ModelConfig):
                           lp["w_down"].astype(cfg.dtype))
 
 
+def _unembed_head(params: Params, cfg: ModelConfig) -> jnp.ndarray:
+    return (params["embed"]["tokens"].T if cfg.tie_embeddings
+            else params["lm_head"]["kernel"])
+
+
 def unembed(x, params: Params, cfg: ModelConfig) -> jnp.ndarray:
     """Final-norm'd hidden states (..., D) -> softcapped f32 logits (..., V)."""
-    head = (params["embed"]["tokens"].T if cfg.tie_embeddings
-            else params["lm_head"]["kernel"])
+    head = _unembed_head(params, cfg)
     logits = jnp.einsum("...d,dv->...v", x, head.astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
     return apply_logits_softcap(logits, cfg)
@@ -178,8 +184,9 @@ def _get_attention_fn(cfg: ModelConfig):
     raise ValueError(f"unknown attention_impl: {cfg.attention_impl!r}")
 
 
-def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
-    """Full-sequence forward pass: (B, S) int32 -> (B, S, V) float32 logits."""
+def forward_hidden(params: Params, tokens: jnp.ndarray,
+                   cfg: ModelConfig) -> jnp.ndarray:
+    """(B, S) int32 -> final-normed hidden states (B, S, D) in cfg.dtype."""
     cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1], cfg.rope_theta)
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
     attn_fn = _get_attention_fn(cfg)
@@ -195,8 +202,12 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarra
         return block(carry, layer_params), None
 
     x, _ = lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
-    return unembed(x, params, cfg)
+    return rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+
+
+def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Full-sequence forward pass: (B, S) int32 -> (B, S, V) float32 logits."""
+    return unembed(forward_hidden(params, tokens, cfg), params, cfg)
 
 
 # ---------------------------------------------------------------------------
@@ -238,13 +249,98 @@ def masked_cross_entropy(logits: jnp.ndarray, batch: dict,
     return loss, metrics
 
 
+def _chunked_logz_target_argmax(x, head, targets, cfg: ModelConfig):
+    """Blockwise-vocab logsumexp + target-logit gather + running argmax.
+
+    x: (B, S, D) activations; head: (D, V); targets: (B, S) int32.
+    Returns (logz, target_logit, argmax_idx), each (B, S) f32/f32/int32,
+    numerically identical (up to accumulation order) to the dense path —
+    without ever materialising (B, S, V) logits. The scan body is
+    `jax.checkpoint`ed, so the backward pass also recomputes logits one
+    chunk at a time instead of saving them.
+    """
+    D, V = head.shape
+    C = cfg.vocab_chunk
+    nc = -(-V // C)
+    if nc * C != V:
+        head = jnp.pad(head, ((0, 0), (0, nc * C - V)))
+    head_c = jnp.moveaxis(head.reshape(D, nc, C), 1, 0)  # (nc, D, C)
+    B, S, _ = x.shape
+
+    def body(carry, inp):
+        m, l, tgt, bidx = carry
+        base, hc = inp
+        logits = jnp.einsum("bsd,dc->bsc", x, hc.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        logits = apply_logits_softcap(logits, cfg)
+        col = base + jax.lax.broadcasted_iota(jnp.int32, (1, 1, C), 2)
+        logits = jnp.where(col < V, logits, NEG_INF)  # padded tail
+        mc = logits.max(-1)
+        # m doubles as the running best-logit, so the argmax update must
+        # compare against the pre-update m.
+        am = base + jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        bidx = jnp.where(mc > m, am, bidx)
+        m_new = jnp.maximum(m, mc)
+        l = l * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[..., None]).sum(-1)
+        in_chunk = (targets >= base) & (targets < base + C)
+        off = jnp.clip(targets - base, 0, C - 1)
+        tl = jnp.take_along_axis(logits, off[..., None], axis=-1)[..., 0]
+        tgt = jnp.where(in_chunk, tl, tgt)
+        return (m_new, l, tgt, bidx), None
+
+    neg = jnp.full((B, S), NEG_INF, jnp.float32)
+    init = (neg, jnp.zeros((B, S), jnp.float32), neg,
+            jnp.zeros((B, S), jnp.int32))
+    bases = jnp.arange(nc, dtype=jnp.int32) * C
+    (m, l, tgt, bidx), _ = lax.scan(
+        jax.checkpoint(body), init, (bases, head_c))
+    return m + jnp.log(l), tgt, bidx
+
+
+def fused_cross_entropy(x, params: Params, batch: dict, cfg: ModelConfig,
+                        z_loss_coef: float = 0.0):
+    """Next-token CE over final hidden states, chunked over the vocab.
+
+    Same contract/metrics as `masked_cross_entropy`, but consumes hidden
+    states (B, S, D) instead of logits. The shift is expressed by pairing
+    position i with target token i+1 and masking the last position, so the
+    sequence dim keeps its full (sp-divisible) length.
+    """
+    tokens = batch["tokens"]
+    targets = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+    mask = batch.get("mask")
+    mask = jnp.ones(tokens.shape, jnp.float32) if mask is None else (
+        mask.astype(jnp.float32))
+    mask = jnp.concatenate(
+        [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1)
+
+    head = _unembed_head(params, cfg)
+    logz, target_logit, argmax_idx = _chunked_logz_target_argmax(
+        x, head, targets, cfg)
+    nll = logz - target_logit
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / denom
+    metrics = {"loss": loss, "ppl_log": loss,
+               "accuracy": ((argmax_idx == targets) * mask).sum() / denom}
+    if z_loss_coef > 0.0:
+        z = (jnp.square(logz) * mask).sum() / denom
+        loss = loss + z_loss_coef * z
+        metrics["z_loss"] = z
+    return loss, metrics
+
+
 def next_token_loss(params: Params, batch: dict, cfg: ModelConfig,
                     z_loss_coef: float = 0.0):
     """Causal LM loss. batch: {"tokens": (B, S) int32, optional "mask": (B, S)}.
 
     Predicts tokens[:, 1:] from tokens[:, :-1]. Forward runs on the full S
     (not S-1) so the sequence stays divisible for sp-sharded attention; the
-    last position's logits are dropped inside `masked_cross_entropy`.
+    last position is dropped inside the loss. With cfg.vocab_chunk > 0 the
+    logits never materialise (see `fused_cross_entropy`).
     """
+    if cfg.vocab_chunk > 0:
+        x = forward_hidden(params, batch["tokens"], cfg)
+        return fused_cross_entropy(x, params, batch, cfg, z_loss_coef)
     logits = forward(params, batch["tokens"], cfg)
     return masked_cross_entropy(logits, batch, z_loss_coef)

@@ -73,9 +73,10 @@ def pipeline_spmd(stage_params, microbatches, stage_fn: Callable,
     return lax.psum(outputs * mask, axis_name)
 
 
-def make_pipelined_forward(model_cfg, mesh: Mesh, num_microbatches: int,
-                           rules=None):
-    """Return forward(params, tokens) running the block stack as a pipeline.
+def make_pipelined_hidden(model_cfg, mesh: Mesh, num_microbatches: int,
+                          rules=None):
+    """Return hidden(params, tokens) -> final-normed (B, S, D) with the
+    block stack run as a pipeline.
 
     Embedding / final norm / head run replicated over pp (they are cheap
     relative to the stack); only the L-layer block scan is pipelined.
@@ -110,7 +111,7 @@ def make_pipelined_forward(model_cfg, mesh: Mesh, num_microbatches: int,
     layer_spec = P("pp")  # stacked layer axis sharded over pp
     batch_spec = P(rules["batch"])
 
-    def forward(params, tokens):
+    def hidden(params, tokens):
         cfg = model_cfg
         cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1],
                                     cfg.rope_theta)
@@ -133,12 +134,19 @@ def make_pipelined_forward(model_cfg, mesh: Mesh, num_microbatches: int,
         micro_out = pipe(params["layers"], micro)
         x = micro_out.reshape(x.shape)
 
-        x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
-        head = (params["embed"]["tokens"].T if cfg.tie_embeddings
-                else params["lm_head"]["kernel"])
-        logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype),
-                            preferred_element_type=jnp.float32)
-        return transformer.apply_logits_softcap(logits, cfg)
+        return rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+
+    return hidden
+
+
+def make_pipelined_forward(model_cfg, mesh: Mesh, num_microbatches: int,
+                           rules=None):
+    """Return forward(params, tokens) -> (B, S, V) f32 logits with the block
+    stack pipelined (see make_pipelined_hidden)."""
+    hidden = make_pipelined_hidden(model_cfg, mesh, num_microbatches, rules)
+
+    def forward(params, tokens):
+        return transformer.unembed(hidden(params, tokens), params, model_cfg)
 
     return forward
 
@@ -146,11 +154,23 @@ def make_pipelined_forward(model_cfg, mesh: Mesh, num_microbatches: int,
 def make_pipelined_loss(model_cfg, mesh: Mesh, num_microbatches: int,
                         z_loss_coef: float = 0.0):
     """Pipelined replacement for transformer.next_token_loss; same signature
-    (params, batch, cfg) so it drops into make_train_step(loss_fn=...)."""
-    fwd = make_pipelined_forward(model_cfg, mesh, num_microbatches)
+    (params, batch, cfg) so it drops into make_train_step(loss_fn=...).
+
+    Honors cfg.vocab_chunk: with vocab_chunk > 0 the loss runs blockwise
+    over the vocab (transformer.fused_cross_entropy) instead of
+    materialising (B, S, V) logits."""
+    hidden = make_pipelined_hidden(model_cfg, mesh, num_microbatches)
 
     def loss_fn(params, batch, cfg):
-        logits = fwd(params, batch["tokens"])
+        # The stack is built from the closed-over model_cfg; ignore the
+        # runtime cfg so the head/softcap/chunking can't silently diverge
+        # from the pipelined body.
+        del cfg
+        x = hidden(params, batch["tokens"])
+        if model_cfg.vocab_chunk > 0:
+            return transformer.fused_cross_entropy(
+                x, params, batch, model_cfg, z_loss_coef)
+        logits = transformer.unembed(x, params, model_cfg)
         return transformer.masked_cross_entropy(logits, batch, z_loss_coef)
 
     return loss_fn

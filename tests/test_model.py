@@ -106,3 +106,34 @@ def test_loss_mask_ignores_padding():
     # masked-out target positions don't contribute...
     # (tokens[:,6] is a target only at position 5 -> masked)
     np.testing.assert_allclose(float(l_half), float(l_half2), rtol=1e-5)
+
+
+def test_fused_ce_matches_dense():
+    """vocab_chunk>0 (blockwise CE) must match the dense logits path on
+    loss, metrics, and gradients."""
+    base = dict(vocab_size=97, embed_dim=32, num_layers=2, num_heads=4,
+                num_kv_heads=2, head_dim=8, mlp_dim=64, max_seq_len=16,
+                dtype="float32", param_dtype="float32", logits_softcap=30.0)
+    dense_cfg = ModelConfig(**base)
+    fused_cfg = ModelConfig(**base, vocab_chunk=32)  # 97 = 3*32 + 1 (pad)
+    params = transformer.init_params(dense_cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 97)
+    mask = (jax.random.uniform(jax.random.key(2), (2, 16)) > 0.2)
+    batch = {"tokens": tokens, "mask": mask}
+
+    (ld, md), gd = jax.value_and_grad(
+        transformer.next_token_loss, has_aux=True)(
+            params, batch, dense_cfg, 1e-3)
+    (lf, mf), gf = jax.value_and_grad(
+        transformer.next_token_loss, has_aux=True)(
+            params, batch, fused_cfg, 1e-3)
+
+    np.testing.assert_allclose(float(lf), float(ld), rtol=1e-5)
+    for k in md:
+        np.testing.assert_allclose(float(mf[k]), float(md[k]), rtol=1e-5,
+                                   err_msg=f"metric {k}")
+    flat_d = jax.tree.leaves(gd)
+    flat_f = jax.tree.leaves(gf)
+    for a, b in zip(flat_f, flat_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)

@@ -94,3 +94,25 @@ def test_moe_trains_with_expert_parallelism(devices8):
     wg = state.params["layers"]["w_gate"]  # (L, E, D, F): E on ep
     assert next(iter(wg.addressable_shards)).data.shape[1] == \
         MOE_TINY.num_experts // 4
+
+
+def test_moe_fused_ce_matches_dense():
+    """vocab_chunk>0 must match the dense MoE loss path (loss + grads)."""
+    import dataclasses
+    fused_cfg = dataclasses.replace(MOE_TINY, vocab_chunk=16)
+    params = moe.init_params(MOE_TINY, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 64)
+    batch = {"tokens": tokens}
+
+    (ld, md), gd = jax.value_and_grad(moe.next_token_loss, has_aux=True)(
+        params, batch, MOE_TINY)
+    (lf, mf), gf = jax.value_and_grad(moe.next_token_loss, has_aux=True)(
+        params, batch, fused_cfg)
+
+    np.testing.assert_allclose(float(lf), float(ld), rtol=1e-5)
+    for k in md:
+        np.testing.assert_allclose(float(mf[k]), float(md[k]), rtol=1e-5,
+                                   err_msg=f"metric {k}")
+    for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)

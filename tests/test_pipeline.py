@@ -70,3 +70,23 @@ def test_pipelined_grads_match_plain(devices8):
     np.testing.assert_allclose(float(lp), float(ld), rtol=1e-5)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), atol=3e-4), gp, gd)
+
+
+def test_pipelined_fused_ce_matches_plain(devices8):
+    """Pipelined loss with vocab_chunk>0 == dense loss, values AND grads
+    (the fused path's point is its checkpointed backward)."""
+    import dataclasses
+    cfg = dataclasses.replace(TINY, vocab_chunk=16)
+    mesh = make_mesh(MeshConfig(pp=4))
+    params = transformer.init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, 64)
+    batch = {"tokens": tokens}
+    loss_fn = make_pipelined_loss(cfg, mesh, num_microbatches=4)
+    (got, _), g = jax.value_and_grad(loss_fn, has_aux=True)(
+        params, batch, cfg)
+    (want, _), g_want = jax.value_and_grad(
+        transformer.next_token_loss, has_aux=True)(params, batch, TINY)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g_want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=1e-3)
