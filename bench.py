@@ -5,21 +5,48 @@ dense decoder LM in bfloat16 and prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 The reference (view-sonic/Cloud-Server @ v0) publishes no numbers
-(BASELINE.md: empty working tree), so vs_baseline is reported as 1.0 by
-definition against an empty baseline; the absolute tokens/sec and MFU are
-the numbers that matter round-over-round.
+(BASELINE.md: empty working tree), so `vs_baseline` is computed against the
+previous round's own result (BENCH_r01.json: 26,249.5 tok/s on this same
+config) — round-over-round regression tracking rather than a constant 1.0.
+
+Config notes (measured on TPU v5e, this repo):
+  * attention_impl="flash" + remat="dots" (with the flash residuals saved
+    via checkpoint_name): 312 -> ~229 ms/step vs the r1 XLA-attention path.
+  * the S=2048 extra compares the pallas flash kernel against XLA dense
+    attention at long sequence in a training-style fwd+bwd.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
 import jax.numpy as jnp
 
+def _baseline_tokens_per_sec() -> float:
+    """Previous round's measured tokens/s (same config & chip), read from
+    BENCH_r01.json so a regenerated baseline can't silently diverge from a
+    hardcoded copy. Falls back to 1:1 if the file is missing."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_r01.json")
+    try:
+        with open(path) as f:
+            return float(json.load(f)["parsed"]["value"])
+    except (OSError, KeyError, ValueError, TypeError):
+        return 0.0
 
-def main() -> None:
+
+def _sync(state, metrics) -> float:
+    """Force completion of everything queued: metrics loss AND a state leaf
+    (the optimizer update may still be in flight after the loss is ready)."""
+    loss = float(metrics["loss"])
+    int(jax.device_get(state.step))
+    return loss
+
+
+def train_bench():
     from cloud_server_tpu.config import MeshConfig, ModelConfig, TrainConfig
     from cloud_server_tpu.parallel.mesh import make_mesh
     from cloud_server_tpu.training import init_train_state, make_train_step
@@ -27,7 +54,8 @@ def main() -> None:
     model_cfg = ModelConfig(
         vocab_size=32000, embed_dim=1024, num_layers=16, num_heads=16,
         num_kv_heads=16, head_dim=64, mlp_dim=4096, max_seq_len=1024,
-        dtype="bfloat16", param_dtype="float32", remat="full")
+        dtype="bfloat16", param_dtype="float32", remat="dots",
+        attention_impl="flash")
     batch, seq = 8, 1024
     train_cfg = TrainConfig(batch_size=batch, seq_len=seq, warmup_steps=10,
                             total_steps=100)
@@ -40,18 +68,15 @@ def main() -> None:
                            model_cfg.vocab_size), batch_sharding)
     data = {"tokens": tokens}
 
-    # Warmup / compile. float() forces a device->host transfer, which is a
-    # true sync even on backends where block_until_ready returns early
-    # (observed on the tunneled 'axon' platform).
     for _ in range(3):
         state, metrics = step(state, data)
-    float(metrics["loss"])
+    _sync(state, metrics)
 
     n_steps = 20
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = step(state, data)
-    loss_val = float(metrics["loss"])
+    loss_val = _sync(state, metrics)
     dt = time.perf_counter() - t0
     if loss_val != loss_val:
         raise SystemExit("bench invalid: loss is NaN")
@@ -67,14 +92,73 @@ def main() -> None:
     flops_per_token = 6 * (n_layer_params + n_embed)
     mfu = flops_per_token * tokens_per_sec / 197e12
 
+    return {
+        "tokens_per_sec": tokens_per_sec,
+        "step_time_ms": 1000 * dt / n_steps,
+        "approx_mfu": mfu,
+    }
+
+
+def longseq_attention_bench():
+    """Training-style fwd+bwd through a 4-layer stack at S=2048:
+    pallas flash kernel vs XLA dense attention."""
+    import dataclasses
+
+    from cloud_server_tpu.config import ModelConfig
+    from cloud_server_tpu.models import transformer
+
+    base = ModelConfig(
+        vocab_size=8192, embed_dim=1024, num_layers=4, num_heads=16,
+        num_kv_heads=16, head_dim=64, mlp_dim=4096, max_seq_len=2048,
+        dtype="bfloat16", param_dtype="float32", remat="dots")
+    tokens = jax.random.randint(jax.random.key(2), (4, 2048), 0,
+                                base.vocab_size)
+    batch = {"tokens": tokens}
+
+    out = {}
+    for impl in ("flash", "xla"):
+        cfg = dataclasses.replace(base, attention_impl=impl)
+        params = transformer.init_params(cfg, jax.random.key(0))
+
+        @jax.jit
+        def grad_fn(params, batch, cfg=cfg):
+            def loss(p):
+                l, _ = transformer.next_token_loss(p, batch, cfg)
+                return l
+            return jax.grad(loss)(params)
+
+        g = grad_fn(params, batch)
+        float(jax.tree.leaves(g)[0].reshape(-1)[0].astype(jnp.float32))
+        n = 10
+        t0 = time.perf_counter()
+        for _ in range(n):
+            g = grad_fn(params, batch)
+        float(jax.tree.leaves(g)[0].reshape(-1)[0].astype(jnp.float32))
+        out[impl] = 1000 * (time.perf_counter() - t0) / n
+    return {"s2048_fwdbwd_flash_ms": out["flash"],
+            "s2048_fwdbwd_xla_ms": out["xla"],
+            "s2048_flash_speedup": out["xla"] / out["flash"]}
+
+
+def main() -> None:
+    train = train_bench()
+    extra = {
+        "step_time_ms": round(train["step_time_ms"], 2),
+        "approx_mfu": round(train["approx_mfu"], 4),
+        "device": str(jax.devices()[0]),
+    }
+    if os.environ.get("BENCH_SKIP_LONGSEQ") != "1":
+        extra.update({k: round(v, 2) for k, v in
+                      longseq_attention_bench().items()})
+
+    base = _baseline_tokens_per_sec()
     print(json.dumps({
         "metric": "train_tokens_per_sec_330M_bf16",
-        "value": round(tokens_per_sec, 1),
+        "value": round(train["tokens_per_sec"], 1),
         "unit": "tokens/s",
-        "vs_baseline": 1.0,
-        "extra": {"step_time_ms": round(1000 * dt / n_steps, 2),
-                  "approx_mfu": round(mfu, 4),
-                  "device": str(jax.devices()[0])},
+        "vs_baseline": (round(train["tokens_per_sec"] / base, 4)
+                        if base > 0 else 1.0),
+        "extra": extra,
     }))
 
 

@@ -37,7 +37,8 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_token: int = 2
     expert_capacity_factor: float = 1.25
-    # rematerialisation policy for the layer scan: "none" | "full" | "dots"
+    # rematerialisation policy for the layer scan:
+    # "none" | "full" | "dots" | "attn" (save only flash-attention residuals)
     remat: str = "full"
     logits_softcap: float = 0.0
     # Training-loss vocab chunk size. 0 = dense path (materialise the full

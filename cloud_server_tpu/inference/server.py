@@ -9,9 +9,11 @@ never waits for old work to finish (continuous batching), and shapes never
 change (no recompiles, no cache reallocation).
 
 Two jitted functions do all device work:
-  * admit:  prefill (1, Pb) → write the slot's cache region + sample the
-    first token. Prompt lengths are bucketed (next power of two) so the
-    prefill compiles once per bucket, not once per length.
+  * admit:  one batched prefill (G, Pb) for the whole admission burst →
+    scatter the slots' cache regions + sample the first tokens. Padded
+    lengths are bucketed (next power of two) and the group row count is
+    padded to a power of two, so compiles are bounded; slot indices are
+    traced (no recompiles on slot choice).
   * decode: one step over the full slot batch. Inactive slots are masked —
     their length doesn't advance and they emit pad. Their cache writes
     land at their frozen length position, which any later occupant
@@ -40,7 +42,6 @@ from typing import Callable, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 from cloud_server_tpu.config import InferConfig, ModelConfig
 from cloud_server_tpu.inference import engine
@@ -80,27 +81,32 @@ def init_slot_state(cfg: ModelConfig, max_slots: int,
 
 
 @partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
-def _admit(params, state: SlotState, prompt: jnp.ndarray,
-           true_len: jnp.ndarray, slot: jnp.ndarray, rng: jax.Array, *,
-           cfg: ModelConfig, infer_cfg: InferConfig):
-    """Prefill prompt (1, Pb) into `slot`; sample its first token.
+def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
+                 true_lens: jnp.ndarray, slots: jnp.ndarray, rng: jax.Array,
+                 *, cfg: ModelConfig, infer_cfg: InferConfig):
+    """Prefill G prompts (G, Pb) into `slots` (G,); sample first tokens.
 
-    `slot` is a traced scalar, so one compilation serves every slot; only
-    the padded prompt length Pb triggers a new compile (bucketed by the
-    caller).
+    A whole admission burst is ONE batched prefill (full MXU batch) instead
+    of G sequential (1, Pb) prefills. Rows whose slot index is out of range
+    (>= max_slots) are padding — `mode="drop"` scatters discard them — so
+    one compilation serves any group of size <= G. `slots` values are
+    traced, so slot choice never recompiles; only (G, Pb) does (both are
+    bucketed by the caller).
+
+    Returns (state', first_tokens (G,)).
     """
-    pb = prompt.shape[1]
-    tmp = engine.init_cache(cfg, 1, pb)
-    logits, tmp = engine.prefill(params, prompt, cfg, tmp, true_len[None])
-    tok = sample_logits(logits, rng, infer_cfg)  # (1,)
+    g, pb = prompts.shape
+    tmp = engine.init_cache(cfg, g, pb)
+    logits, tmp = engine.prefill(params, prompts, cfg, tmp, true_lens)
+    toks = sample_logits(logits, rng, infer_cfg)  # (G,)
 
-    k = lax.dynamic_update_slice(state.k, tmp.k, (0, slot, 0, 0, 0))
-    v = lax.dynamic_update_slice(state.v, tmp.v, (0, slot, 0, 0, 0))
+    k = state.k.at[:, slots, :pb].set(tmp.k, mode="drop")
+    v = state.v.at[:, slots, :pb].set(tmp.v, mode="drop")
     return SlotState(
         k=k, v=v,
-        length=state.length.at[slot].set(true_len),
-        last_token=state.last_token.at[slot].set(tok[0]),
-        active=state.active.at[slot].set(True))
+        length=state.length.at[slots].set(true_lens, mode="drop"),
+        last_token=state.last_token.at[slots].set(toks, mode="drop"),
+        active=state.active.at[slots].set(True, mode="drop")), toks
 
 
 @partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
@@ -252,26 +258,48 @@ class InferenceServer:
         req._done.set()
 
     def _admit_pending(self) -> None:
-        while True:
-            with self._lock:
-                if not self._pending:
-                    return
-                free = [i for i, r in enumerate(self._slots) if r is None]
-                if not free:
-                    return
+        """Admit every admissible pending request in ONE batched prefill.
+
+        A burst of K pending requests costs one `_admit_batch` dispatch and
+        one device_get (the first tokens), so active decode slots stall for
+        a single prefill round-trip rather than K of them. The group's
+        padded length is the bucket of its longest prompt and its row count
+        is padded to a power of two, bounding compilations to
+        O(len(prompt_buckets) * log2(max_slots)).
+        """
+        with self._lock:
+            if not self._pending:
+                return
+            free = [i for i, r in enumerate(self._slots) if r is None]
+            group: list[tuple[int, Request]] = []
+            while self._pending and len(group) < len(free):
                 req = self._pending.popleft()
-                slot = free[0]
+                slot = free[len(group)]
                 self._slots[slot] = req
-            pb = _bucket(len(req.prompt), self.prompt_buckets)
-            prompt = np.full((1, pb), self.infer_cfg.pad_token_id, np.int32)
-            prompt[0, :len(req.prompt)] = req.prompt
-            self.state = _admit(
-                self.params, self.state, jnp.asarray(prompt),
-                jnp.int32(len(req.prompt)), jnp.int32(slot),
-                self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg)
-            # the admission token (sampled from prefill logits)
-            first = int(jax.device_get(self.state.last_token[slot]))
-            if self._emit(req, first):
+                group.append((slot, req))
+        if not group:
+            return
+
+        pb = _bucket(max(len(r.prompt) for _, r in group),
+                     self.prompt_buckets)
+        gpad = 1
+        while gpad < len(group):
+            gpad *= 2
+        prompts = np.full((gpad, pb), self.infer_cfg.pad_token_id, np.int32)
+        true_lens = np.ones((gpad,), np.int32)
+        # padding rows target slot == max_slots: out of range -> dropped
+        slots = np.full((gpad,), self.max_slots, np.int32)
+        for i, (slot, req) in enumerate(group):
+            prompts[i, :len(req.prompt)] = req.prompt
+            true_lens[i] = len(req.prompt)
+            slots[i] = slot
+        self.state, toks = _admit_batch(
+            self.params, self.state, jnp.asarray(prompts),
+            jnp.asarray(true_lens), jnp.asarray(slots),
+            self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg)
+        toks = np.asarray(jax.device_get(toks))
+        for i, (slot, req) in enumerate(group):
+            if self._emit(req, int(toks[i])):
                 self._finish(slot, req)
 
     @property

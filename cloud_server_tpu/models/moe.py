@@ -189,8 +189,7 @@ def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
     attn_fn = transformer._get_attention_fn(cfg)
 
     block = partial(_moe_block, cfg=cfg, cos=cos, sin=sin, attn_fn=attn_fn)
-    if cfg.remat == "full":
-        block = jax.checkpoint(block)
+    block = transformer.apply_remat(block, cfg)
 
     def scan_body(carry, lp):
         x, lb, rz, dropped = carry

@@ -108,6 +108,13 @@ def quantize_params(params: Any) -> Any:
     out = []
     for path, leaf in flat:
         name = path[-1].key if hasattr(path[-1], "key") else None
+        # ("kernel", 2) is keyed on the generic name "kernel"; its axis-0
+        # scales are only correct for the lm_head (D, V) matrix, so gate on
+        # the parent key rather than quantizing any stray 2-D "kernel".
+        if name == "kernel" and not (
+                len(path) >= 2 and getattr(path[-2], "key", None) == "lm_head"):
+            out.append(leaf)
+            continue
         axes = _REDUCE_AXES.get((name, getattr(leaf, "ndim", -1)))
         out.append(quantize(leaf, axes) if axes is not None else leaf)
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -164,6 +164,39 @@ def _block(x, layer_params, cfg: ModelConfig, cos, sin, attn_fn):
     return x
 
 
+def apply_remat(block, cfg: ModelConfig):
+    """Wrap a layer-block fn with the configured remat policy.
+
+    The single policy-selection point for the dense stack, the MoE stack,
+    and the pipelined stack — keep them identical. Policies:
+      * "none": save everything (no checkpoint).
+      * "full": recompute everything.
+      * "dots": save matmul outputs AND the flash kernel's (out, lse)
+        residuals — pallas calls aren't dots, so without the name policy
+        the backward re-runs the whole flash forward just to rebuild them.
+      * "attn": save ONLY the flash residuals; recompute everything else
+        (incl. the big (B, S, mlp_dim) gate/up tensors, whose dots-policy
+        saves can cost more HBM traffic than their recompute FLOPs). Only
+        meaningful with attention_impl="flash" — other impls emit no named
+        residuals, making this equivalent to "full".
+    """
+    if cfg.remat == "none":
+        return block
+    if cfg.remat == "full":
+        return jax.checkpoint(block)
+    if cfg.remat == "dots":
+        return jax.checkpoint(
+            block, policy=jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_out", "flash_lse")))
+    if cfg.remat == "attn":
+        return jax.checkpoint(
+            block, policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"))
+    raise ValueError(f"unknown remat policy: {cfg.remat!r}")
+
+
 def _get_attention_fn(cfg: ModelConfig):
     if cfg.attention_impl == "xla":
         return causal_attention
@@ -192,11 +225,7 @@ def forward_hidden(params: Params, tokens: jnp.ndarray,
     attn_fn = _get_attention_fn(cfg)
 
     block = partial(_block, cfg=cfg, cos=cos, sin=sin, attn_fn=attn_fn)
-    if cfg.remat == "full":
-        block = jax.checkpoint(block)
-    elif cfg.remat == "dots":
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    block = apply_remat(block, cfg)
 
     def scan_body(carry, layer_params):
         return block(carry, layer_params), None

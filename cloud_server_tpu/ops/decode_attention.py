@@ -56,11 +56,16 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
         kv_pos = j * block_s + jax.lax.broadcasted_iota(
             jnp.int32, (g, block_s), 1)
         valid = kv_pos < length
+        # Boundary blocks are padded by pallas with whatever bits are in
+        # VMEM; p=exp(NEG_INF - m)=0 alone is not enough if a padded v row
+        # holds NaN/Inf (0*NaN=NaN), so zero invalid v rows before the pv dot.
+        v_valid = (j * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (block_s, 1), 0)) < length
         for ki in range(kh):
             rows = slice(ki * g, (ki + 1) * g)
             q = q_ref[0, ki].astype(jnp.float32)       # (G, Dh)
             k = k_ref[0, :, ki].astype(jnp.float32)    # (block_s, Dh)
-            v = v_ref[0, :, ki].astype(jnp.float32)
+            v = jnp.where(v_valid, v_ref[0, :, ki], 0).astype(jnp.float32)
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # (G, block_s)

@@ -44,6 +44,22 @@ def _default_block(seq: int, want: int) -> int:
     return max(b, 1)
 
 
+
+def _dot(a, b, dims):
+    """dot_general with f32 accumulation and dtype-determined precision.
+
+    bf16 operands must use DEFAULT precision — a global
+    jax_default_matmul_precision="highest" (tests/conftest.py sets it for
+    CPU numerics) would request an fp32 contraction on bf16 vectors, which
+    Mosaic rejects ("Bad lhs type"). f32 operands keep HIGHEST so the
+    interpret-mode parity tests stay exact.
+    """
+    prec = (jax.lax.Precision.DEFAULT if a.dtype == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+    return jax.lax.dot_general(a, b, (dims, ((), ())), precision=prec,
+                               preferred_element_type=jnp.float32)
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
@@ -62,12 +78,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     # Block-level causal skip: kv block strictly after the q block's end.
     @pl.when(j * block_kv <= i * block_q + block_q - 1)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # (bq, d)
-        k = k_ref[0, 0].astype(jnp.float32)  # (bkv, d)
-        v = v_ref[0, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (bq, bkv)
+        # Feed the MXU its native operand dtype (bf16 in, f32 accumulate);
+        # casting to f32 first would force multi-pass f32 matmuls.
+        q = q_ref[0, 0]  # (bq, d)
+        k = k_ref[0, 0]  # (bkv, d)
+        v = v_ref[0, 0]
+        s = _dot(q, k, ((1,), (1,))) * scale  # (bq, bkv)
 
         q_pos = i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 0)
@@ -81,9 +97,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m_prev - m_new)
         l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr + _dot(p.astype(v.dtype), v, ((1,), (0,)))
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -131,6 +145,11 @@ def _fwd(q, k, v, *, scale, block_q, block_kv, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
+    # Named so remat policies can choose to save these instead of re-running
+    # the kernel in the backward pass (see models/transformer.py remat="dots").
+    from jax.ad_checkpoint import checkpoint_name
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, lse
 
 
@@ -154,15 +173,15 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
 
     @pl.when(i * block_q + block_q - 1 >= j * block_kv)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        # Raw (bf16) operands into every dot; f32 only for the softmax math.
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         o = o_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, :1]  # lane-padded (bq, LSE_LANES) -> (bq, 1)
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        s = _dot(q, k, ((1,), (1,))) * scale
         q_pos = i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 0)
         kv_pos = j * block_kv + jax.lax.broadcasted_iota(
@@ -170,19 +189,44 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
         mask = q_pos >= kv_pos
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bkv)
 
-        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
-        delta = jnp.sum(do * o, axis=-1, keepdims=True)  # (bq, 1)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+        dv_acc[:] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
+        delta = jnp.sum(do.astype(jnp.float32) * o, axis=-1,
+                        keepdims=True)  # (bq, 1)
+        dp = _dot(do, v, ((1,), (1,)))
         ds = p * (dp - delta) * scale
-        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+        dk_acc[:] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))
 
     @pl.when(i == pl.num_programs(3) - 1)
     def _finalize():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
+                      dq_ref, dk_ref, dv_ref, *, scale, sq):
+    """Whole-sequence backward: one grid cell per (batch, head) computes
+    dq, dk, dv together, so s and p are built once instead of once per
+    kernel. Only used when the sequence fits a single block (S <= block);
+    the blocked two-kernel path below handles longer sequences."""
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    o = o_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0][:, :1]
+
+    s = _dot(q, k, ((1,), (1,))) * scale
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
+    kv_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
+    p = jnp.where(q_pos >= kv_pos, jnp.exp(s - lse), 0.0)
+
+    pc = p.astype(do.dtype)
+    dv_ref[0, 0] = _dot(pc, do, ((0,), (0,))).astype(dv_ref.dtype)
+    delta = jnp.sum(do.astype(jnp.float32) * o, axis=-1, keepdims=True)
+    dp = _dot(do, v, ((1,), (1,)))
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    dq_ref[0, 0] = _dot(ds, k, ((1,), (0,))).astype(dq_ref.dtype)
+    dk_ref[0, 0] = _dot(ds, q, ((0,), (0,))).astype(dk_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
@@ -195,27 +239,24 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
 
     @pl.when(j * block_kv <= i * block_q + block_q - 1)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         o = o_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, :1]  # lane-padded (bq, LSE_LANES) -> (bq, 1)
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        s = _dot(q, k, ((1,), (1,))) * scale
         q_pos = i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 0)
         kv_pos = j * block_kv + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 1)
         p = jnp.where(q_pos >= kv_pos, jnp.exp(s - lse), 0.0)
 
-        delta = jnp.sum(do * o, axis=-1, keepdims=True)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+        delta = jnp.sum(do.astype(jnp.float32) * o, axis=-1, keepdims=True)
+        dp = _dot(do, v, ((1,), (1,)))
         ds = p * (dp - delta) * scale
-        dq_acc[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+        dq_acc[:] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
     @pl.when(j == pl.num_programs(3) - 1)
     def _finalize():
@@ -244,6 +285,10 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
     b, h, sq, d = q.shape
     _, kh, skv, _ = k.shape
     g = h // kh
+
+    if sq == skv and sq <= block_q and skv <= block_kv:
+        return _flash_bwd_fused(q, k, v, out, lse, do, scale=scale,
+                                interpret=interpret)
 
     nq, nkv = pl.cdiv(sq, block_q), pl.cdiv(skv, block_kv)
 
@@ -294,11 +339,36 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
     return dq, dk, dv
 
 
+def _flash_bwd_fused(q, k, v, out, lse, do, *, scale, interpret):
+    b, h, sq, d = q.shape
+    _, kh, _, _ = k.shape
+    g = h // kh
+
+    q_spec = pl.BlockSpec((1, 1, sq, d), lambda bi, hi: (bi, hi, 0, 0))
+    kv_spec = pl.BlockSpec((1, 1, sq, d), lambda bi, hi: (bi, hi // g, 0, 0))
+    lse_spec = pl.BlockSpec((1, 1, sq, LSE_LANES),
+                            lambda bi, hi: (bi, hi, 0, 0))
+
+    dq, dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, sq=sq),
+        grid=(b, h),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, lse_spec, q_spec],
+        out_specs=[q_spec, q_spec, q_spec],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, out, lse, do)
+    dk = dk_h.reshape(b, kh, g, sq, d).sum(axis=2).astype(k.dtype)
+    dv = dv_h.reshape(b, kh, g, sq, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention(q, k, v, *, scale=None, block_q: int = 512,
-                    block_kv: int = 512, interpret: bool | None = None):
+def flash_attention(q, k, v, *, scale=None, block_q: int = 1024,
+                    block_kv: int = 1024, interpret: bool | None = None):
     """Causal flash attention, (B, S, H, Dh) layout like ops.attention.
 
     q: (B, S, H, Dh); k, v: (B, S, KH, Dh). Returns (B, S, H, Dh).

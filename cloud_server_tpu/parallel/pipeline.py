@@ -94,12 +94,7 @@ def make_pipelined_hidden(model_cfg, mesh: Mesh, num_microbatches: int,
         def stage_fn(stage_params, x):
             block = functools.partial(transformer._block, cfg=model_cfg,
                                       cos=cos, sin=sin, attn_fn=attn_fn)
-            if model_cfg.remat == "full":
-                block = jax.checkpoint(block)
-            elif model_cfg.remat == "dots":
-                block = jax.checkpoint(
-                    block,
-                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+            block = transformer.apply_remat(block, model_cfg)
 
             def scan_body(h, lp):
                 return block(h, lp), None

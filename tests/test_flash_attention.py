@@ -92,3 +92,23 @@ def test_compiled_on_tpu():
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32), atol=0.15,
                                    err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("h,kh", [(4, 4), (4, 2)])
+def test_backward_fused_single_block(h, kh):
+    """S <= block takes the fused one-pass dq/dk/dv kernel; it must match
+    dense exactly like the blocked two-kernel path does."""
+    q, k, v = _rand_qkv(5, 2, 64, h, kh, 16)
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, block_q=64, block_kv=64,
+                                interpret=True) ** 2).sum()
+
+    def f_dense(q, k, v):
+        return (causal_attention(q, k, v) ** 2).sum()
+
+    gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
+                                   rtol=1e-3, err_msg=f"d{name}")

@@ -138,3 +138,36 @@ def test_slot_reuse_no_leakage(params):
     second = srv.generate([PROMPTS[2]], max_new_tokens=10)[0]
     assert first == _engine_reference(params, PROMPTS[1], 10)
     assert second == _engine_reference(params, PROMPTS[2], 10)
+
+
+def test_burst_admission_is_one_batched_prefill(params, monkeypatch):
+    """A burst of K pending requests admits in ONE _admit_batch dispatch
+    (not K sequential prefills), and active slots still decode that step."""
+    from cloud_server_tpu.inference import server as server_mod
+
+    calls = []
+    real = server_mod._admit_batch
+
+    def counting(*args, **kwargs):
+        calls.append(args[2].shape)  # prompts (G, Pb)
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(server_mod, "_admit_batch", counting)
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=4, max_len=64,
+                          prompt_buckets=[16])
+    r0 = srv.submit(PROMPTS[0], max_new_tokens=10)
+    srv.step()
+    assert len(calls) == 1
+    n0 = len(r0.tokens)
+
+    # burst: three more arrive while r0 decodes
+    reqs = [srv.submit(p, max_new_tokens=6) for p in PROMPTS[1:]]
+    srv.step()
+    assert len(calls) == 2, "burst must be a single batched prefill"
+    assert calls[1][0] >= 3  # whole burst in one group
+    # the active slot advanced in the same step despite the burst
+    assert len(r0.tokens) == n0 + 1
+    srv.run_until_idle()
+    assert r0.result() == _engine_reference(params, PROMPTS[0], 10)
+    for p, r in zip(PROMPTS[1:], reqs):
+        assert r.result() == _engine_reference(params, p, 6)
