@@ -140,6 +140,54 @@ def longseq_attention_bench():
             "s2048_flash_speedup": out["xla"] / out["flash"]}
 
 
+def serving_bench():
+    """Steady-state continuous-batching decode through InferenceServer on
+    the 330M model: 8 slots x 1024 cache, xla vs pallas decode attention,
+    bf16 vs int8 weights. Decode is HBM-bound (weights + cache streamed per
+    token), which is exactly what the pallas decode kernel and int8
+    quantization exist to cut — this measures both claims."""
+    import dataclasses
+
+    from cloud_server_tpu.config import InferConfig, ModelConfig
+    from cloud_server_tpu.inference.server import InferenceServer
+    from cloud_server_tpu.models import transformer
+    from cloud_server_tpu.models.quantization import quantize_params
+
+    base = ModelConfig(
+        vocab_size=32000, embed_dim=1024, num_layers=16, num_heads=16,
+        num_kv_heads=16, head_dim=64, mlp_dim=4096, max_seq_len=1024,
+        dtype="bfloat16", param_dtype="float32", remat="none")
+    infer_cfg = InferConfig(max_decode_len=900, temperature=1.0,
+                            eos_token_id=-1, pad_token_id=0)
+    params_bf16 = transformer.init_params(base, jax.random.key(0))
+    params_int8 = quantize_params(params_bf16)
+    prompts = [list(range(1, 65)) for _ in range(8)]
+
+    chunk = 32  # multi-token scheduling: one host sync per 32 decode steps
+    out = {}
+    for impl in ("xla", "pallas"):
+        for wname, params in (("bf16", params_bf16), ("int8", params_int8)):
+            cfg = dataclasses.replace(base, decode_attention_impl=impl)
+            srv = InferenceServer(params, cfg, infer_cfg, max_slots=8,
+                                  max_len=1024, prompt_buckets=[64],
+                                  decode_chunk=chunk)
+            for p in prompts:
+                srv.submit(p, max_new_tokens=900)
+            for _ in range(3):  # admit + warm the decode jit
+                srv.step()
+            n = 8
+            tokens_before = sum(len(r.tokens) for r in srv._slots if r)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                srv.step()
+            dt = time.perf_counter() - t0
+            tokens_after = sum(len(r.tokens) for r in srv._slots if r)
+            out[f"decode_tok_s_{impl}_{wname}"] = (
+                (tokens_after - tokens_before) / dt)
+            del srv, cfg
+    return out
+
+
 def main() -> None:
     train = train_bench()
     extra = {
@@ -150,6 +198,8 @@ def main() -> None:
     if os.environ.get("BENCH_SKIP_LONGSEQ") != "1":
         extra.update({k: round(v, 2) for k, v in
                       longseq_attention_bench().items()})
+    if os.environ.get("BENCH_SKIP_SERVING") != "1":
+        extra.update({k: round(v, 1) for k, v in serving_bench().items()})
 
     base = _baseline_tokens_per_sec()
     print(json.dumps({

@@ -36,6 +36,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--add-bos", action="store_true",
                    help="prepend BOS to prompts (only if training data "
                    "contained BOS — prepare_corpus does not emit it)")
+    p.add_argument("--quantize", action="store_true",
+                   help="serve with int8 weight-only quantization (halves "
+                   "the weight bytes streamed per decode step)")
+    p.add_argument("--serve-http", type=int, metavar="PORT", default=None,
+                   help="instead of batch generation, run the continuous-"
+                   "batching server behind an HTTP streaming endpoint "
+                   "(POST /generate, ndjson token stream; GET /healthz)")
+    p.add_argument("--decode-chunk", type=int, default=1,
+                   help="decode steps per scheduler iteration (multi-token "
+                   "scheduling; >1 amortises host sync at the cost of "
+                   "admission latency)")
     from cloud_server_tpu.models.lora import add_lora_args
     add_lora_args(p)
     return p
@@ -93,7 +104,7 @@ def main(argv=None) -> None:
             prompts.extend(line.rstrip("\n") for line in sys.stdin)
         else:
             prompts.append(prm)
-    if not prompts:
+    if not prompts and args.serve_http is None:
         raise SystemExit("no prompts (use --prompt, repeatable, or '-')")
 
     from cloud_server_tpu.models.lora import (
@@ -119,20 +130,45 @@ def main(argv=None) -> None:
     else:
         params = load_params(model_cfg, args.checkpoint_dir, args.step,
                              args.seed)
-    encoded = [tok.encode(p, add_bos=args.add_bos and tok.bos_id is not None)
-               or [0] for p in prompts]
-    longest = max(len(e) for e in encoded)
-    max_len = args.max_len or min(model_cfg.max_seq_len,
-                                  longest + args.max_new)
+    if args.quantize:
+        from cloud_server_tpu.models.quantization import quantize_params
+        params = quantize_params(params)
     infer_cfg = InferConfig(
         max_decode_len=args.max_new, temperature=args.temperature,
         top_k=args.top_k, top_p=args.top_p,
         eos_token_id=tok.eos_id if tok.eos_id is not None else -1,
         pad_token_id=tok.pad_id or 0)
 
+    if args.serve_http is not None:
+        from cloud_server_tpu.inference.http_server import HttpFrontend
+        max_len = args.max_len or model_cfg.max_seq_len
+        srv = InferenceServer(params, model_cfg, infer_cfg, max_slots=8,
+                              max_len=max_len, seed=args.seed,
+                              decode_chunk=args.decode_chunk).start()
+        front = HttpFrontend(srv, tokenizer=tok, port=args.serve_http)
+        front.start()
+        host, port = front.address
+        print(f"[generate] serving on http://{host}:{port} — try:\n"
+              f"  curl -N -s {host}:{port}/generate "
+              "-d '{\"prompt\": \"hello\"}'", file=sys.stderr)
+        try:
+            import signal
+            signal.pause()
+        except (KeyboardInterrupt, AttributeError):
+            pass
+        finally:
+            front.stop()
+            srv.stop()
+        return
+
+    encoded = [tok.encode(p, add_bos=args.add_bos and tok.bos_id is not None)
+               or [0] for p in prompts]
+    longest = max(len(e) for e in encoded)
+    max_len = args.max_len or min(model_cfg.max_seq_len,
+                                  longest + args.max_new)
     srv = InferenceServer(params, model_cfg, infer_cfg,
                           max_slots=min(8, len(encoded)), max_len=max_len,
-                          seed=args.seed)
+                          seed=args.seed, decode_chunk=args.decode_chunk)
     outs = srv.generate(encoded, max_new_tokens=args.max_new)
     for prompt, out in zip(prompts, outs):
         print(f"=== {prompt!r}")

@@ -3,3 +3,4 @@ from cloud_server_tpu.inference.engine import (  # noqa: F401
     KVCache, generate, init_cache, prefill)
 from cloud_server_tpu.inference.server import (  # noqa: F401
     InferenceServer, Request)
+from cloud_server_tpu.inference.http_server import HttpFrontend  # noqa: F401

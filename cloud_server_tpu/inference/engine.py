@@ -100,15 +100,6 @@ def prefill(params, tokens: jnp.ndarray, cfg: ModelConfig, cache: KVCache,
 # Decode
 # ---------------------------------------------------------------------------
 
-def _update_at(cache_layer: jnp.ndarray, new: jnp.ndarray,
-               pos: jnp.ndarray) -> jnp.ndarray:
-    """Write new (B, 1, KH, Dh) into cache_layer (B, max_len, KH, Dh) at
-    per-sequence position pos (B,)."""
-    return jax.vmap(
-        lambda c, u, s: lax.dynamic_update_slice(c, u, (s, 0, 0))
-    )(cache_layer, new, pos)
-
-
 def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
                 cache: KVCache) -> tuple[jnp.ndarray, KVCache]:
     """One decode step. token: (B,) int32; sequence i sits at position
@@ -134,22 +125,28 @@ def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
         raise ValueError(
             f"unknown decode_attention_impl: {cfg.decode_attention_impl!r}")
 
-    def scan_body(carry, layer):
-        x = carry
-        lp, k_cache, v_cache = layer
+    # Unrolled layer loop with in-place slice updates. A lax.scan with the
+    # cache as stacked ys re-materialises the full (L, B, S, KH, Dh) k/v
+    # buffers every token (~1 GB of pure copies per step at the 330M bench
+    # config — measured ~5 ms/step of `copy.*` ops on TPU v5e). Unrolling
+    # lets XLA chain donated dynamic-update-slices on the same buffers, so
+    # per-step cache traffic is just the (B, 1, KH, Dh) writes plus the
+    # attention reads.
+    k_all, v_all = cache.k, cache.v
+    batch_idx = jnp.arange(token.shape[0])
+    for layer_idx in range(cfg.num_layers):
+        lp = jax.tree.map(lambda w: w[layer_idx], params["layers"])
         q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin, positions)
-        k_cache = _update_at(k_cache, k, pos)
-        v_cache = _update_at(v_cache, v, pos)
-        o = attend(q, k_cache, v_cache)
+        # scatter the new (B, KH, Dh) entries straight into the stacked
+        # cache — no read-modify-write of the whole 32MB layer slice
+        k_all = k_all.at[layer_idx, batch_idx, pos].set(k[:, 0])
+        v_all = v_all.at[layer_idx, batch_idx, pos].set(v[:, 0])
+        o = attend(q, k_all[layer_idx], v_all[layer_idx])
         x = transformer.attention_out(x, o, lp, cfg)
         x = transformer.mlp_block(x, lp, cfg)
-        return x, (k_cache, v_cache)
-
-    x, (new_k, new_v) = lax.scan(
-        scan_body, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
     logits = transformer.unembed(x[:, 0], params, cfg)
-    return logits, KVCache(new_k, new_v, cache.length + 1)
+    return logits, KVCache(k_all, v_all, cache.length + 1)
 
 
 # ---------------------------------------------------------------------------

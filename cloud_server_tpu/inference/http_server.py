@@ -1,0 +1,155 @@
+"""HTTP front-end for the continuous-batching server.
+
+A thin stdlib (`http.server`) layer over `InferenceServer.submit`: prompts
+go in as JSON, tokens stream back as newline-delimited JSON the moment the
+scheduler emits them. No framework dependency — the serving hot path stays
+the jitted TPU program; this module only does sockets and JSON.
+
+Protocol:
+  POST /generate    {"prompt": "text"} or {"tokens": [1, 2, 3]},
+                    optional "max_new_tokens". Response is
+                    `application/x-ndjson`: one {"token": id, "text": s}
+                    line per generated token (text only when a tokenizer is
+                    attached), then a final
+                    {"done": true, "finish_reason": ..., "tokens": [...]}.
+  GET  /healthz     {"ok": true, "active": N, "pending": N}
+
+Demo (server side: `python -m cloud_server_tpu.generate --serve-http 8000
+...` or `HttpFrontend(srv, tok).start()`):
+
+  curl -N -s localhost:8000/generate -d '{"prompt": "the meaning of"}'
+
+Reference parity note: view-sonic/Cloud-Server @ v0 is an empty tree
+(SURVEY.md); this subsystem is part of the re-scoped build inventory
+(network serving front-end).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from cloud_server_tpu.inference.server import InferenceServer
+
+_STREAM_END = object()
+
+
+class HttpFrontend:
+    """Bind an InferenceServer (+ optional tokenizer) to an HTTP port.
+
+    The InferenceServer's scheduler must be running (srv.start()) or be
+    driven externally; this class never steps it.
+    """
+
+    def __init__(self, srv: InferenceServer, tokenizer=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.srv = srv
+        self.tokenizer = tokenizer
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet by default
+                pass
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = (json.dumps(payload) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path != "/healthz":
+                    self._json(404, {"error": "unknown path"})
+                    return
+                self._json(200, {"ok": True, "active": front.srv.num_active,
+                                 "pending": front.srv.num_pending})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._json(404, {"error": "unknown path"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(req, dict):
+                        raise ValueError("body must be a JSON object")
+                    max_new = req.get("max_new_tokens")
+                    if max_new is not None and not isinstance(max_new, int):
+                        raise ValueError('"max_new_tokens" must be an int')
+                    tokens = front._encode(req)
+                except (ValueError, KeyError, TypeError) as exc:
+                    self._json(400, {"error": str(exc)})
+                    return
+
+                q: queue.Queue = queue.Queue()
+                try:
+                    request = front.srv.submit(
+                        tokens, max_new_tokens=max_new, stream=q.put)
+                except ValueError as exc:
+                    self._json(400, {"error": str(exc)})
+                    return
+                except RuntimeError as exc:  # scheduler stopped/crashed
+                    self._json(503, {"error": str(exc)})
+                    return
+
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                threading.Thread(  # unblock q.get when generation ends
+                    target=lambda: (request._done.wait(),
+                                    q.put(_STREAM_END)),
+                    daemon=True).start()
+                while True:
+                    tok = q.get()
+                    if tok is _STREAM_END:
+                        break
+                    line = {"token": int(tok)}
+                    if front.tokenizer is not None:
+                        line["text"] = front.tokenizer.decode([int(tok)])
+                    self.wfile.write((json.dumps(line) + "\n").encode())
+                    self.wfile.flush()
+                self.wfile.write((json.dumps(
+                    {"done": True, "finish_reason": request.finish_reason,
+                     "tokens": request.tokens}) + "\n").encode())
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    def _encode(self, req: dict) -> list[int]:
+        if "tokens" in req:
+            tokens = req["tokens"]
+            if (not isinstance(tokens, list)
+                    or not all(isinstance(t, int) for t in tokens)):
+                raise ValueError('"tokens" must be a list of ints')
+            return tokens
+        if "prompt" in req:
+            if self.tokenizer is None:
+                raise ValueError(
+                    'no tokenizer attached; send {"tokens": [...]} instead')
+            return self.tokenizer.encode(req["prompt"]) or [0]
+        raise ValueError('body needs "prompt" or "tokens"')
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    def start(self) -> "HttpFrontend":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="http-frontend")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -22,8 +22,11 @@ Two jitted functions do all device work:
 
 The host side is a small scheduler: a pending queue, per-request token
 accumulation, EOS / max-token completion, optional streaming callbacks.
-One device_get of the (max_slots,) token vector per decode step is the
-only host↔device sync.
+One device_get of the sampled-token block per scheduler iteration is the
+only host↔device sync; with `decode_chunk > 1` (multi-token scheduling)
+that iteration covers up to decode_chunk tokens per slot via an on-device
+`lax.scan`, amortising dispatch latency at the cost of up to chunk-1
+steps of admission latency.
 
 Sharding: wrap `params` (and the server's jits inherit via input
 shardings) with tp/fsdp NamedShardings for multi-chip serving; the slot
@@ -42,6 +45,7 @@ from typing import Callable, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from cloud_server_tpu.config import InferConfig, ModelConfig
 from cloud_server_tpu.inference import engine
@@ -109,13 +113,9 @@ def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
         active=state.active.at[slots].set(True, mode="drop")), toks
 
 
-@partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
-def _decode(params, state: SlotState, rng: jax.Array, *, cfg: ModelConfig,
-            infer_cfg: InferConfig):
-    """One decode step over all slots; inactive slots are frozen.
-
-    Returns (state', tokens (B,) int32) with pad in inactive rows.
-    """
+def _decode_core(params, state: SlotState, rng: jax.Array,
+                 cfg: ModelConfig, infer_cfg: InferConfig):
+    """One decode step over all slots; inactive slots are frozen."""
     cache = engine.KVCache(state.k, state.v, state.length)
     logits, cache = engine.decode_step(params, state.last_token, cfg, cache)
     tok = sample_logits(logits, rng, infer_cfg)
@@ -123,6 +123,34 @@ def _decode(params, state: SlotState, rng: jax.Array, *, cfg: ModelConfig,
     length = jnp.where(state.active, cache.length, state.length)
     return SlotState(k=cache.k, v=cache.v, length=length, last_token=tok,
                      active=state.active), tok
+
+
+@partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
+def _decode(params, state: SlotState, rng: jax.Array, *, cfg: ModelConfig,
+            infer_cfg: InferConfig):
+    """Returns (state', tokens (B,) int32) with pad in inactive rows."""
+    return _decode_core(params, state, rng, cfg, infer_cfg)
+
+
+@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "n_steps"),
+         donate_argnums=(1,))
+def _decode_chunk(params, state: SlotState, rng: jax.Array, *,
+                  cfg: ModelConfig, infer_cfg: InferConfig, n_steps: int):
+    """n_steps decode steps in ONE dispatch (lax.scan on device).
+
+    Multi-token scheduling: the host syncs (device_get of the sampled
+    tokens) once per chunk instead of once per token, amortising dispatch
+    and host<->device latency over n_steps tokens. The host discards any
+    in-chunk tokens past a request's EOS / budget afterwards, so chunking
+    trades at most n_steps - 1 wasted decode steps (and that much admission
+    latency) for steady-state throughput.
+
+    Returns (state', tokens (n_steps, B) int32).
+    """
+    def body(st, r):
+        return _decode_core(params, st, r, cfg, infer_cfg)
+
+    return lax.scan(body, state, jax.random.split(rng, n_steps))
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -174,12 +202,34 @@ class InferenceServer:
 
     def __init__(self, params, cfg: ModelConfig, infer_cfg: InferConfig, *,
                  max_slots: int = 8, max_len: int = 1024,
-                 prompt_buckets: Sequence[int] | None = None, seed: int = 0):
-        self.params = params
+                 prompt_buckets: Sequence[int] | None = None, seed: int = 0,
+                 decode_chunk: int = 1):
+        # Serving never needs f32 master weights: pre-cast float32 leaves to
+        # the compute dtype once, instead of streaming 2x the bytes and
+        # converting on every decode step. QTensor leaves stay quantized
+        # (their .astype dequantizes — applied at use, not here).
+        from cloud_server_tpu.models.quantization import QTensor
+        target = jnp.dtype(cfg.dtype)
+
+        def cast_leaf(w):
+            if isinstance(w, QTensor):
+                return w
+            if getattr(w, "dtype", None) == jnp.float32 and w.ndim >= 1:
+                return w.astype(target)
+            return w
+
+        self.params = jax.tree.map(
+            cast_leaf, params, is_leaf=lambda x: isinstance(x, QTensor))
         self.cfg = cfg
         self.infer_cfg = infer_cfg
         self.max_slots = max_slots
         self.max_len = max_len
+        # Max decode steps per scheduler iteration (multi-token scheduling).
+        # 1 = sync every token (lowest admission latency); larger values
+        # amortise dispatch/host-sync overhead over the chunk. The actual
+        # chunk never exceeds any active request's remaining budget, so no
+        # request overshoots its max_new_tokens or the cache.
+        self.decode_chunk = max(1, decode_chunk)
         if prompt_buckets is None:
             # powers of two, with max_len itself always the last bucket so
             # any prompt the cache can hold is admissible
@@ -209,6 +259,11 @@ class InferenceServer:
     def submit(self, prompt: Sequence[int], *,
                max_new_tokens: int | None = None,
                stream: Callable[[int], None] | None = None) -> Request:
+        if self._stop.is_set():
+            # stop() was called or serve_forever died on a fatal error —
+            # accepting now would enqueue work nothing will ever drain and
+            # hang the caller's result() forever.
+            raise RuntimeError("server is stopped; not accepting requests")
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         _bucket(len(prompt), self.prompt_buckets)  # raises if too long
@@ -311,6 +366,20 @@ class InferenceServer:
         with self._lock:
             return len(self._pending)
 
+    def _chunk_len(self) -> int:
+        """Decode steps to run this iteration: bounded by decode_chunk and
+        by the tightest remaining token budget among active requests (so a
+        chunk can never decode past a request's max_new_tokens, which also
+        bounds its cache length — submit() guarantees prompt + max_new <=
+        max_len). Rounded down to a power of two to bound compilations."""
+        remaining = min(r.max_new_tokens - len(r.tokens)
+                        for r in self._slots if r is not None)
+        n = min(self.decode_chunk, max(1, remaining))
+        p = 1
+        while p * 2 <= n:
+            p *= 2
+        return p
+
     def step(self) -> int:
         """One scheduler iteration; returns number of active slots.
 
@@ -320,13 +389,21 @@ class InferenceServer:
             self._admit_pending()
             if self.num_active == 0:
                 return 0
-            self.state, toks = _decode(
-                self.params, self.state, self._next_rng(),
-                cfg=self.cfg, infer_cfg=self.infer_cfg)
-            toks = np.asarray(jax.device_get(toks))
-            for slot, req in enumerate(self._slots):
-                if req is not None and self._emit(req, int(toks[slot])):
-                    self._finish(slot, req)
+            n = self._chunk_len()
+            if n == 1:
+                self.state, toks = _decode(
+                    self.params, self.state, self._next_rng(),
+                    cfg=self.cfg, infer_cfg=self.infer_cfg)
+                chunk = np.asarray(jax.device_get(toks))[None]  # (1, B)
+            else:
+                self.state, toks = _decode_chunk(
+                    self.params, self.state, self._next_rng(),
+                    cfg=self.cfg, infer_cfg=self.infer_cfg, n_steps=n)
+                chunk = np.asarray(jax.device_get(toks))  # (n, B)
+            for t in range(chunk.shape[0]):
+                for slot, req in enumerate(self._slots):
+                    if req is not None and self._emit(req, int(chunk[t, slot])):
+                        self._finish(slot, req)
             return self.num_active
 
     def _fail_all(self, exc: BaseException) -> None:

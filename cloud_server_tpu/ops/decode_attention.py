@@ -91,13 +91,20 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
             o_ref.dtype)
 
 
-def _default_block(seq: int, want: int) -> int:
+def _default_block(seq: int, want: int, kh: int, d: int,
+                   itemsize: int) -> int:
     # block_s need not divide seq: the grid uses cdiv and the boundary
     # block is padded by pallas, with padded rows masked by the kv_pos <
     # length guard in the kernel (padded kv_pos >= seq >= length always).
     # Requiring divisibility here would collapse block_s to 1 for odd cache
     # lengths (e.g. prompt 1000 + 25 new tokens), an enormous perf cliff.
-    return min(seq, want)
+    b = min(seq, want)
+    # Each grid cell stages k AND v blocks of (block_s, kh, d) in VMEM,
+    # double-buffered. Cap the per-block footprint or Mosaic's scoped-vmem
+    # allocator rejects the kernel (observed at block_s=512, kh=16, d=64).
+    while b > 8 and b * kh * d * itemsize > 512 * 1024:
+        b //= 2
+    return b
 
 
 def decode_attention(q, k_cache, v_cache, lengths, *, scale=None,
@@ -123,7 +130,7 @@ def decode_attention(q, k_cache, v_cache, lengths, *, scale=None,
         scale = d**-0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    block_s = _default_block(s, block_s)
+    block_s = _default_block(s, block_s, kh, d, k_cache.dtype.itemsize)
 
     qg = q.reshape(b, kh, g, d)
     grid = (b, pl.cdiv(s, block_s))

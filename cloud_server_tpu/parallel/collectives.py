@@ -50,9 +50,9 @@ def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int):
                           concat_axis=concat_axis, tiled=True)
 
 
-def axis_index(axis: str):
-    return lax.axis_index(axis)
-
-
-def axis_size(axis: str):
-    return lax.axis_size(axis)
+def ring_exchange(chunks, axis: str, *, shift: int = 1):
+    """Rotate every leaf of a pytree one hop around the ring — the k/v
+    rotation step of ring attention and the stage handoff of the pipeline.
+    A single named entry point so a DCN-aware or pallas-DMA implementation
+    can replace the hop without touching the algorithms."""
+    return jax.tree.map(lambda x: ppermute_shift(x, axis, shift), chunks)

@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from cloud_server_tpu.models import transformer
+from cloud_server_tpu.parallel import collectives
 
 
 def pipeline_spmd(stage_params, microbatches, stage_fn: Callable,
@@ -60,8 +61,7 @@ def pipeline_spmd(stage_params, microbatches, stage_fn: Callable,
                 o, y, jnp.clip(out_idx, 0, m - 1), axis=0),
             lambda o: o,
             outputs)
-        perm = [(i, (i + 1) % pp) for i in range(pp)]
-        recv_next = lax.ppermute(y, axis_name, perm)
+        recv_next = collectives.ppermute_shift(y, axis_name, 1)
         return (recv_next, outputs), None
 
     recv0 = jnp.zeros_like(microbatches[0])
@@ -70,7 +70,7 @@ def pipeline_spmd(stage_params, microbatches, stage_fn: Callable,
 
     # Only the last stage holds real outputs; masked psum broadcasts them.
     mask = (stage == pp - 1).astype(outputs.dtype)
-    return lax.psum(outputs * mask, axis_name)
+    return collectives.psum(outputs * mask, axis_name)
 
 
 def make_pipelined_hidden(model_cfg, mesh: Mesh, num_microbatches: int,

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from cloud_server_tpu.parallel import collectives
+
 NEG_INF = -1e30
 
 
@@ -86,15 +88,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     m = jnp.full((b, kh, g, sq, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((b, kh, g, sq, 1), jnp.float32)
 
-    perm = [(i, (i + 1) % n) for i in range(n)]
-
     def body(t, state):
         acc, m, l, kc, vc = state
         src = (idx - t) % n  # who this kv chunk belongs to
         acc, m, l = _chunk_merge((acc, m, l), q, kc, vc,
                                  q_off, src * skv, scale)
-        kc, vc = jax.tree.map(
-            lambda x: lax.ppermute(x, axis_name, perm), (kc, vc))
+        kc, vc = collectives.ring_exchange((kc, vc), axis_name)
         return acc, m, l, kc, vc
 
     # n-1 fold+rotate steps, then a final fold with no wasted rotation.

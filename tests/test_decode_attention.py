@@ -98,7 +98,10 @@ def test_non_divisible_cache_length(s, block_s):
     Regression for the perf cliff where odd cache lengths (e.g. prompt 1000
     + 25 new tokens => S=1025) collapsed block_s to 1."""
     from cloud_server_tpu.ops.decode_attention import _default_block
-    assert _default_block(1025, 512) == 512
+    # small kh*d: the VMEM cap leaves the requested block untouched
+    assert _default_block(1025, 512, kh=4, d=16, itemsize=4) == 512
+    # big kh*d (the 330M serving config): capped to fit scoped VMEM
+    assert _default_block(1024, 512, kh=16, d=64, itemsize=2) == 256
     q, k, v, lengths = _case(s=s)
     out = decode_attention(q, k, v, lengths, block_s=block_s)
     np.testing.assert_allclose(np.asarray(out),

@@ -171,3 +171,31 @@ def test_burst_admission_is_one_batched_prefill(params, monkeypatch):
     assert r0.result() == _engine_reference(params, PROMPTS[0], 10)
     for p, r in zip(PROMPTS[1:], reqs):
         assert r.result() == _engine_reference(params, p, 6)
+
+
+def test_decode_chunk_matches_unchunked(params):
+    """Multi-token scheduling (decode_chunk>1) must produce exactly the
+    same greedy tokens as per-token stepping, including a final partial
+    chunk (max_new not a multiple of the chunk)."""
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=4, max_len=64,
+                          prompt_buckets=[16], decode_chunk=4)
+    outs = srv.generate(PROMPTS, max_new_tokens=6)  # 6 = 4 + 2
+    for prompt, out in zip(PROMPTS, outs):
+        assert out == _engine_reference(params, prompt, 6), prompt
+
+
+def test_decode_chunk_respects_eos(params):
+    """A request hitting EOS mid-chunk stops there; trailing in-chunk
+    tokens are discarded and the slot frees for pending work."""
+    ref = _engine_reference(params, PROMPTS[0], 12)
+    # pick an EOS token whose FIRST occurrence is mid-chunk (index >= 2)
+    idx = next(i for i in range(2, len(ref)) if ref[i] not in ref[:i])
+    icfg = dataclasses.replace(GREEDY, eos_token_id=ref[idx])
+    srv = InferenceServer(params, CFG, icfg, max_slots=1, max_len=64,
+                          prompt_buckets=[16], decode_chunk=8)
+    r0 = srv.submit(PROMPTS[0], max_new_tokens=12)
+    r1 = srv.submit(PROMPTS[2], max_new_tokens=4)  # queued behind r0
+    srv.run_until_idle()
+    assert r0.result() == ref[:idx]
+    assert r0.finish_reason == "eos"
+    assert r1.done
