@@ -186,6 +186,7 @@ def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
     """(B, S) -> (final-normed hidden (B, S, D), aux dict of router stats)."""
     cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1], cfg.rope_theta)
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
+    x = transformer.constrain(x, ("batch", "sequence", None))
     attn_fn = transformer._get_attention_fn(cfg)
 
     block = partial(_moe_block, cfg=cfg, cos=cos, sin=sin, attn_fn=attn_fn)

@@ -22,7 +22,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from cloud_server_tpu.config import ModelConfig
-from cloud_server_tpu.ops import apply_rope, causal_attention, rms_norm, rope_frequencies, swiglu
+from cloud_server_tpu.ops import (apply_rope, causal_attention, rms_norm,
+                                  rope_frequencies, swiglu)
+from cloud_server_tpu.parallel.sharding import constrain
 
 Params = dict
 
@@ -222,6 +224,10 @@ def forward_hidden(params: Params, tokens: jnp.ndarray,
     """(B, S) int32 -> final-normed hidden states (B, S, D) in cfg.dtype."""
     cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1], cfg.rope_theta)
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
+    # Anchor the residual stream to (batch, sequence, -) so that with
+    # sp > 1 every per-position op (norms, MLP, fused CE) computes S/sp per
+    # device; only ring attention's shard_map sees the full sequence.
+    x = constrain(x, ("batch", "sequence", None))
     attn_fn = _get_attention_fn(cfg)
 
     block = partial(_block, cfg=cfg, cos=cos, sin=sin, attn_fn=attn_fn)
@@ -231,6 +237,7 @@ def forward_hidden(params: Params, tokens: jnp.ndarray,
         return block(carry, layer_params), None
 
     x, _ = lax.scan(scan_body, x, params["layers"])
+    x = constrain(x, ("batch", "sequence", None))
     return rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
 
 

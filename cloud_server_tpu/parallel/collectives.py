@@ -50,6 +50,20 @@ def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int):
                           concat_axis=concat_axis, tiled=True)
 
 
+def pvary(x, axes):
+    """Mark `x` as varying over `axes` (vma promotion for check_vma).
+
+    jax.lax.pvary is deprecated in favour of lax.pcast(..., to="varying");
+    this shim keeps one call site to track the API. No-op for empty axes.
+    """
+    axes = tuple(axes)
+    if not axes:
+        return x
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    return lax.pvary(x, axes)
+
+
 def ring_exchange(chunks, axis: str, *, shift: int = 1):
     """Rotate every leaf of a pytree one hop around the ring — the k/v
     rotation step of ring attention and the stage handoff of the pipeline.

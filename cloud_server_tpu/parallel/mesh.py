@@ -39,6 +39,12 @@ def current_mesh() -> Mesh:
     return _CURRENT_MESH
 
 
+def maybe_current_mesh() -> Mesh | None:
+    """current_mesh() for callers that degrade gracefully without one
+    (e.g. activation sharding anchors in model code)."""
+    return _CURRENT_MESH
+
+
 def make_mesh(cfg: MeshConfig, devices=None) -> Mesh:
     """Build a named Mesh with canonical axis order from a MeshConfig.
 

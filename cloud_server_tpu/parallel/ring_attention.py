@@ -84,9 +84,13 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     idx = lax.axis_index(axis_name)
     q_off = idx * sq
 
-    acc = jnp.zeros((b, kh, g, sq, dh), jnp.float32)
-    m = jnp.full((b, kh, g, sq, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((b, kh, g, sq, 1), jnp.float32)
+    # Fresh accumulators are unvarying; inside shard_map they must carry
+    # the same varying-manual-axes (vma) set as the chunks they accumulate,
+    # or check_vma=True (the collective sanitizer mode) rejects the scan.
+    vma = tuple(jax.typeof(q).vma)
+    acc = collectives.pvary(jnp.zeros((b, kh, g, sq, dh), jnp.float32), vma)
+    m = collectives.pvary(jnp.full((b, kh, g, sq, 1), NEG_INF, jnp.float32), vma)
+    l = collectives.pvary(jnp.zeros((b, kh, g, sq, 1), jnp.float32), vma)
 
     def body(t, state):
         acc, m, l, kc, vc = state
@@ -113,4 +117,4 @@ def ring_attention_sharded(q, k, v, mesh, *, scale=None,
     fn = functools.partial(ring_attention, axis_name=seq_axis, scale=scale)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
-        check_vma=False)(q, k, v)
+        check_vma=True)(q, k, v)

@@ -47,6 +47,36 @@ def spec_from_logical(logical_axes: Sequence[str | None],
     return P(*[rules[a] if a is not None else None for a in logical_axes])
 
 
+def constrain(x, logical_axes: Sequence[str | None],
+              rules: ShardingRules = DEFAULT_RULES):
+    """Anchor an activation's sharding by logical axis names.
+
+    `with_sharding_constraint` against the process-wide mesh — the way
+    model code pins activation layouts (e.g. the sequence dim onto sp)
+    without ever naming mesh axes. Degrades to a no-op when:
+      * no mesh is registered (pure single-device library use),
+      * called eagerly (unit tests poking at forwards outside jit),
+      * every mesh axis the spec names has size 1 (nothing to anchor —
+        also keeps a stale registered mesh from touching unrelated jits).
+    """
+    from cloud_server_tpu.parallel.mesh import maybe_current_mesh
+
+    mesh = maybe_current_mesh()
+    if mesh is None or not isinstance(x, jax.core.Tracer):
+        return x
+    spec = spec_from_logical(logical_axes, rules)
+    named = [a for entry in spec if entry is not None
+             for a in (entry if isinstance(entry, tuple) else (entry,))
+             if a is not None]
+    # A custom registered mesh may not carry the canonical axis names;
+    # "degrades to a no-op" must hold there too.
+    if any(a not in mesh.shape for a in named):
+        return x
+    if all(mesh.shape[a] == 1 for a in named):
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def logical_to_sharding(logical_tree: Any, mesh: Mesh,
                         rules: ShardingRules = DEFAULT_RULES) -> Any:
     """Map a pytree of logical-axis tuples to a pytree of NamedShardings.

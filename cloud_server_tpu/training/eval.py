@@ -38,8 +38,8 @@ def make_eval_step(model_cfg: ModelConfig, mesh: Mesh, rules=DEFAULT_RULES,
     loss_fn = loss_fn or loss_fn_module.next_token_loss
     logical = loss_fn_module.param_logical_axes(model_cfg)
     param_sharding = logical_to_sharding(logical, mesh, rules)
-    batch_sharding = NamedSharding(mesh, spec_from_logical(("batch", None),
-                                                           rules))
+    batch_sharding = NamedSharding(
+        mesh, spec_from_logical(("batch", "sequence"), rules))
     replicated = NamedSharding(mesh, P())
 
     def eval_fn(params, batch):
@@ -55,8 +55,17 @@ def make_eval_step(model_cfg: ModelConfig, mesh: Mesh, rules=DEFAULT_RULES,
         return {"nll_sum": ce * n, "n_tokens": n,
                 "n_correct": metrics["accuracy"] * n}
 
-    step = jax.jit(eval_fn, in_shardings=(param_sharding, batch_sharding),
-                   out_shardings=replicated)
+    jit_step = jax.jit(eval_fn, in_shardings=(param_sharding, batch_sharding),
+                       out_shardings=replicated)
+
+    def step(params, batch):
+        # Pin the registered mesh for trace-time consumers (constrain(),
+        # attention_impl="ring"): a make_mesh() call between build and first
+        # invocation must not rebind them to an unrelated mesh.
+        from cloud_server_tpu.parallel.mesh import set_current_mesh
+        set_current_mesh(mesh)
+        return jit_step(params, batch)
+
     return step, batch_sharding
 
 

@@ -100,7 +100,12 @@ def make_train_step(model_cfg: ModelConfig, train_cfg: TrainConfig,
         loss_fn = partial(loss_fn_module.next_token_loss, **kwargs)
     opt = optimizer_for_module(train_cfg, model_cfg, loss_fn_module)
     shardings = state_shardings(model_cfg, mesh, rules, loss_fn_module)
-    batch_spec = spec_from_logical(("batch", None), rules)
+    # (B, S): batch over (dp, fsdp), sequence over sp — with sp > 1 every
+    # activation downstream of the embedding (norms, MLP, fused CE) computes
+    # S/sp per device; only ring attention sees the full sequence, via its
+    # shard_map. XLA propagates the S-sharding from this input spec plus
+    # the anchor constraint in transformer.forward_hidden.
+    batch_spec = spec_from_logical(("batch", "sequence"), rules)
     batch_sharding = NamedSharding(mesh, batch_spec)
     replicated = NamedSharding(mesh, P())
 
@@ -142,10 +147,19 @@ def make_train_step(model_cfg: ModelConfig, train_cfg: TrainConfig,
                                opt_state=new_opt)
         return new_state, metrics
 
-    step = jax.jit(
+    jit_step = jax.jit(
         step_fn,
         in_shardings=(shardings, batch_sharding),
         out_shardings=(shardings, replicated),
         donate_argnums=(0,),
     )
+
+    def step(state, batch):
+        # Pin the registered mesh for trace-time consumers (constrain(),
+        # attention_impl="ring"): a make_mesh() call between build and first
+        # invocation must not rebind them to an unrelated mesh.
+        from cloud_server_tpu.parallel.mesh import set_current_mesh
+        set_current_mesh(mesh)
+        return jit_step(state, batch)
+
     return step, batch_sharding

@@ -1,4 +1,5 @@
 from cloud_server_tpu.utils.failure import (  # noqa: F401
+    CollectiveWatchdog,
     NaNGuard,
     PreemptionHandler,
     TrainingDiverged,
