@@ -21,6 +21,10 @@ def build_parser() -> argparse.ArgumentParser:
                    "used at training time")
     p.add_argument("--checkpoint-dir",
                    help="training checkpoint directory (omit: random init)")
+    p.add_argument("--hf-checkpoint", metavar="DIR",
+                   help="local HuggingFace LLaMA-family checkpoint "
+                   "directory to serve (mutually exclusive with "
+                   "--checkpoint-dir/--config model section)")
     p.add_argument("--step", type=int, help="checkpoint step (default latest)")
     p.add_argument("--tokenizer", default="byte",
                    help='"byte" or a local tokenizer.json path')
@@ -86,12 +90,25 @@ def main(argv=None) -> None:
     if args.config:
         with open(args.config) as f:
             raw = json.load(f)
-    model_cfg = from_json(ModelConfig, raw.get("model", {}))
-    if model_cfg.num_experts >= 2:
-        raise SystemExit(
-            "the generate CLI serves dense models only; the inference "
-            "engine has no MoE decode path yet (train.py supports MoE "
-            "training, but its checkpoints can't be served here)")
+    hf_params = None
+    if args.hf_checkpoint:
+        if args.checkpoint_dir:
+            raise SystemExit(
+                "--hf-checkpoint and --checkpoint-dir are mutually "
+                "exclusive")
+        if args.step is not None:
+            raise SystemExit("--step does not apply to --hf-checkpoint")
+        from cloud_server_tpu.models.lora import lora_config_from_args
+        if lora_config_from_args(args) is not None:
+            raise SystemExit(
+                "--lora-* flags do not apply to --hf-checkpoint (merge "
+                "adapters into an HF checkpoint first, or train from a "
+                "framework checkpoint)")
+        from cloud_server_tpu.models.hf_convert import load_hf_checkpoint
+        model_cfg, hf_params = load_hf_checkpoint(
+            args.hf_checkpoint, **raw.get("model", {}))
+    else:
+        model_cfg = from_json(ModelConfig, raw.get("model", {}))
     tok = get_tokenizer(args.tokenizer)
     if tok.vocab_size > model_cfg.vocab_size:
         raise SystemExit(
@@ -122,14 +139,19 @@ def main(argv=None) -> None:
                     f"recorded LoRA config {saved}; drop the flags (the "
                     "sidecar is used automatically)")
             lcfg = saved
-    if lcfg is not None:
+    if hf_params is not None:
+        params = hf_params
+    elif lcfg is not None:
         params = load_params(model_cfg, args.checkpoint_dir, args.step,
                              args.seed,
                              loss_fn_module=make_lora_module(lcfg))
         params = export_merged(params, lcfg)
     else:
+        moe_module = None
+        if model_cfg.num_experts >= 2:
+            from cloud_server_tpu.models import moe as moe_module
         params = load_params(model_cfg, args.checkpoint_dir, args.step,
-                             args.seed)
+                             args.seed, loss_fn_module=moe_module)
     if args.quantize:
         from cloud_server_tpu.models.quantization import quantize_params
         params = quantize_params(params)

@@ -44,6 +44,22 @@ class KVCache(NamedTuple):
     length: jnp.ndarray  # (B,) int32 — valid entries per sequence
 
 
+def _mlp_apply(x, lp, cfg: ModelConfig):
+    """Dense or MoE MLP residual block, chosen by cfg.num_experts.
+
+    MoE routing at inference is per-call: prefill routes over the prompt
+    batch, each decode step over its B single tokens. Capacity therefore
+    differs from training's full-batch routing — exact parity with the
+    training forward holds only when nothing drops (generous
+    expert_capacity_factor), which is also the sane serving configuration.
+    """
+    if cfg.num_experts >= 2:
+        from cloud_server_tpu.models import moe
+        x, _ = moe.moe_mlp_block(x, lp, cfg)
+        return x
+    return transformer.mlp_block(x, lp, cfg)
+
+
 def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:
     shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
     dtype = jnp.dtype(cfg.dtype)
@@ -79,7 +95,7 @@ def prefill(params, tokens: jnp.ndarray, cfg: ModelConfig, cache: KVCache,
         q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin)
         o = attn_fn(q, k, v)
         x = transformer.attention_out(x, o, lp, cfg)
-        x = transformer.mlp_block(x, lp, cfg)
+        x = _mlp_apply(x, lp, cfg)
         return x, (k, v)
 
     x, (ks, vs) = lax.scan(scan_body, x, params["layers"])
@@ -143,7 +159,7 @@ def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
         v_all = v_all.at[layer_idx, batch_idx, pos].set(v[:, 0])
         o = attend(q, k_all[layer_idx], v_all[layer_idx])
         x = transformer.attention_out(x, o, lp, cfg)
-        x = transformer.mlp_block(x, lp, cfg)
+        x = _mlp_apply(x, lp, cfg)
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
     logits = transformer.unembed(x[:, 0], params, cfg)
     return logits, KVCache(k_all, v_all, cache.length + 1)

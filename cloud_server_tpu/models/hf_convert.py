@@ -1,0 +1,185 @@
+"""HuggingFace LLaMA-family checkpoint interop.
+
+Lets a user bring existing weights to this framework (and take ours back
+out): `LlamaForCausalLM`-style state dicts convert losslessly to/from our
+parameter tree. The RoPE convention matches (both use the half-split
+"rotate_half" layout and the same theta schedule), so conversion is pure
+reshaping/transposition — verified to logits parity against the
+`transformers` reference implementation in tests/test_hf_convert.py.
+
+Layout mapping (HF `nn.Linear.weight` is (out, in); ours are (in, out)-
+style einsum operands):
+
+  model.embed_tokens.weight (V, D)      -> embed.tokens (V, D)
+  layers.i.self_attn.q_proj (H*Dh, D)   -> wq[i] (D, H, Dh)    (T + reshape)
+  layers.i.self_attn.k_proj (KH*Dh, D)  -> wk[i] (D, KH, Dh)
+  layers.i.self_attn.v_proj (KH*Dh, D)  -> wv[i] (D, KH, Dh)
+  layers.i.self_attn.o_proj (D, H*Dh)   -> wo[i] (H, Dh, D)
+  layers.i.mlp.gate_proj (F, D)         -> w_gate[i] (D, F)
+  layers.i.mlp.up_proj (F, D)           -> w_up[i] (D, F)
+  layers.i.mlp.down_proj (D, F)         -> w_down[i] (F, D)
+  layers.i.input_layernorm (D,)         -> attn_norm[i]
+  layers.i.post_attention_layernorm (D,)-> mlp_norm[i]
+  model.norm.weight (D,)                -> final_norm.scale
+  lm_head.weight (V, D)                 -> lm_head.kernel (D, V)
+                                           (absent when tie_word_embeddings)
+
+Reference parity note: view-sonic/Cloud-Server @ v0 is an empty tree
+(SURVEY.md); checkpoint interop is part of the re-scoped build inventory.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from cloud_server_tpu.config import ModelConfig
+
+
+def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
+    """Build a ModelConfig from a transformers LlamaConfig-like object."""
+    fields = dict(
+        vocab_size=hf_config.vocab_size,
+        embed_dim=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads",
+                             hf_config.num_attention_heads),
+        head_dim=getattr(hf_config, "head_dim", None)
+        or hf_config.hidden_size // hf_config.num_attention_heads,
+        mlp_dim=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(hf_config.rms_norm_eps),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                    False)),
+    )
+    fields.update(overrides)
+    return ModelConfig(**fields)
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().to("cpu").float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def params_from_hf(state_dict: Mapping[str, Any], cfg: ModelConfig,
+                   dtype: str | None = None) -> dict:
+    """Convert an HF LlamaForCausalLM state dict to this framework's
+    parameter tree (leaves in `dtype`, default cfg.param_dtype).
+
+    Conversion is per-key lazy: each tensor is pulled from the (possibly
+    torch, possibly bf16) state dict and converted on use, so peak host
+    memory stays near one extra copy rather than a full f32 duplicate of
+    the checkpoint."""
+    L, D, H, KH, Dh = (cfg.num_layers, cfg.embed_dim, cfg.num_heads,
+                       cfg.num_kv_heads, cfg.head_dim)
+    out_dtype = jnp.dtype(dtype or cfg.param_dtype)
+
+    def get(key: str) -> np.ndarray:
+        return _np(state_dict[key])
+
+    def stack(fmt: str) -> np.ndarray:
+        return np.stack([get(fmt.format(i)) for i in range(L)])
+
+    wq = stack("model.layers.{}.self_attn.q_proj.weight")  # (L, H*Dh, D)
+    wk = stack("model.layers.{}.self_attn.k_proj.weight")
+    wv = stack("model.layers.{}.self_attn.v_proj.weight")
+    wo = stack("model.layers.{}.self_attn.o_proj.weight")  # (L, D, H*Dh)
+
+    params = {
+        "embed": {"tokens": jnp.asarray(
+            get("model.embed_tokens.weight"), out_dtype)},
+        "layers": {
+            "attn_norm": jnp.asarray(
+                stack("model.layers.{}.input_layernorm.weight"), out_dtype),
+            "mlp_norm": jnp.asarray(
+                stack("model.layers.{}.post_attention_layernorm.weight"),
+                out_dtype),
+            "wq": jnp.asarray(
+                wq.transpose(0, 2, 1).reshape(L, D, H, Dh), out_dtype),
+            "wk": jnp.asarray(
+                wk.transpose(0, 2, 1).reshape(L, D, KH, Dh), out_dtype),
+            "wv": jnp.asarray(
+                wv.transpose(0, 2, 1).reshape(L, D, KH, Dh), out_dtype),
+            "wo": jnp.asarray(
+                wo.transpose(0, 2, 1).reshape(L, H, Dh, D), out_dtype),
+            "w_gate": jnp.asarray(
+                stack("model.layers.{}.mlp.gate_proj.weight"
+                      ).transpose(0, 2, 1), out_dtype),
+            "w_up": jnp.asarray(
+                stack("model.layers.{}.mlp.up_proj.weight"
+                      ).transpose(0, 2, 1), out_dtype),
+            "w_down": jnp.asarray(
+                stack("model.layers.{}.mlp.down_proj.weight"
+                      ).transpose(0, 2, 1), out_dtype),
+        },
+        "final_norm": {"scale": jnp.asarray(
+            get("model.norm.weight"), out_dtype)},
+    }
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" not in state_dict:
+            raise ValueError(
+                "state dict has no lm_head.weight but cfg.tie_embeddings "
+                "is False — pass a config with tie_embeddings=True")
+        params["lm_head"] = {"kernel": jnp.asarray(
+            get("lm_head.weight").T, out_dtype)}
+    return params
+
+
+def params_to_hf(params: Mapping[str, Any], cfg: ModelConfig) -> dict:
+    """Inverse of `params_from_hf`: our tree -> HF state-dict numpy arrays
+    (torch-free; wrap with torch.from_numpy for transformers)."""
+    L, D, H, KH, Dh = (cfg.num_layers, cfg.embed_dim, cfg.num_heads,
+                       cfg.num_kv_heads, cfg.head_dim)
+    lp = params["layers"]
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(
+            params["embed"]["tokens"], np.float32),
+        "model.norm.weight": np.asarray(
+            params["final_norm"]["scale"], np.float32),
+    }
+    for i in range(L):
+        pre = f"model.layers.{i}"
+        sd[f"{pre}.input_layernorm.weight"] = np.asarray(
+            lp["attn_norm"][i], np.float32)
+        sd[f"{pre}.post_attention_layernorm.weight"] = np.asarray(
+            lp["mlp_norm"][i], np.float32)
+        sd[f"{pre}.self_attn.q_proj.weight"] = np.asarray(
+            lp["wq"][i], np.float32).reshape(D, H * Dh).T
+        sd[f"{pre}.self_attn.k_proj.weight"] = np.asarray(
+            lp["wk"][i], np.float32).reshape(D, KH * Dh).T
+        sd[f"{pre}.self_attn.v_proj.weight"] = np.asarray(
+            lp["wv"][i], np.float32).reshape(D, KH * Dh).T
+        sd[f"{pre}.self_attn.o_proj.weight"] = np.asarray(
+            lp["wo"][i], np.float32).reshape(H * Dh, D).T
+        sd[f"{pre}.mlp.gate_proj.weight"] = np.asarray(
+            lp["w_gate"][i], np.float32).T
+        sd[f"{pre}.mlp.up_proj.weight"] = np.asarray(
+            lp["w_up"][i], np.float32).T
+        sd[f"{pre}.mlp.down_proj.weight"] = np.asarray(
+            lp["w_down"][i], np.float32).T
+    if not cfg.tie_embeddings:
+        sd["lm_head.weight"] = np.asarray(
+            params["lm_head"]["kernel"], np.float32).T
+    return sd
+
+
+def load_hf_checkpoint(path: str,
+                       **config_overrides) -> tuple[ModelConfig, dict]:
+    """Load a local HF LLaMA-family checkpoint directory: returns
+    (ModelConfig, params). Requires `transformers` + `torch` (CPU).
+
+    `config_overrides` go to ModelConfig (e.g. dtype="float32",
+    attention_impl="flash"); parameter leaves follow the resulting
+    cfg.param_dtype. The torch model loads in its checkpoint dtype
+    (torch_dtype="auto"), not f32, to halve peak host memory."""
+    import transformers
+
+    model = transformers.AutoModelForCausalLM.from_pretrained(
+        path, torch_dtype="auto")
+    cfg = config_from_hf(model.config, **config_overrides)
+    return cfg, params_from_hf(model.state_dict(), cfg)

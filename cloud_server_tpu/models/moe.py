@@ -175,11 +175,21 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
     return jax.tree.unflatten(treedef, out)
 
 
-def _moe_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn):
-    x = transformer._attention_block(x, lp, cfg, cos, sin, attn_fn)
+def moe_mlp_block(x, lp, cfg: ModelConfig):
+    """Residual MoE MLP sub-block: norm -> route/experts -> add.
+
+    The single definition shared by training (`_moe_block`) and the
+    inference engine (`engine._mlp_apply`), so serve-time MoE math can
+    never drift from the trained model.
+    """
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     out, aux = moe_mlp(h, lp, cfg)
     return x + out, aux
+
+
+def _moe_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn):
+    x = transformer._attention_block(x, lp, cfg, cos, sin, attn_fn)
+    return moe_mlp_block(x, lp, cfg)
 
 
 def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
