@@ -24,12 +24,22 @@ class ModelConfig:
     mlp_dim: int = 8192
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
+    # RoPE frequency scaling for long-context checkpoints:
+    # "none" | "linear" (divide all frequencies by factor) | "llama3"
+    # (Llama 3.1 band-wise interpolation; see ops/rope.py:_scale_inv_freq)
+    rope_scaling: str = "none"
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_len: int = 8192
     norm_eps: float = 1e-6
     tie_embeddings: bool = False
     # numerics
     dtype: str = "bfloat16"  # activation/compute dtype
     param_dtype: str = "float32"  # master parameter dtype
-    # attention implementation: "xla" | "flash" | "ring"
+    # attention implementation: "xla" | "flash" | "ring" | "ulysses"
+    # ("ring" and "ulysses" are the two sequence-parallel schemes over sp:
+    #  ppermute kv rotation vs all-to-all head re-sharding)
     attention_impl: str = "xla"
     # decode-time (cached, single-query) attention: "xla" | "pallas"
     decode_attention_impl: str = "xla"

@@ -24,7 +24,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hf-checkpoint", metavar="DIR",
                    help="local HuggingFace LLaMA-family checkpoint "
                    "directory to serve (mutually exclusive with "
-                   "--checkpoint-dir/--config model section)")
+                   "--checkpoint-dir; a --config model section may still "
+                   "override behavioral fields like dtype/attention_impl — "
+                   "structural fields that contradict the checkpoint are "
+                   "rejected)")
     p.add_argument("--step", type=int, help="checkpoint step (default latest)")
     p.add_argument("--tokenizer", default="byte",
                    help='"byte" or a local tokenizer.json path')
@@ -142,6 +145,10 @@ def main(argv=None) -> None:
     if hf_params is not None:
         params = hf_params
     elif lcfg is not None:
+        if model_cfg.num_experts >= 2:
+            raise SystemExit(
+                "--lora-* flags support the dense family only (LoRA "
+                "adapters wrap the dense transformer, not the MoE stack)")
         params = load_params(model_cfg, args.checkpoint_dir, args.step,
                              args.seed,
                              loss_fn_module=make_lora_module(lcfg))

@@ -35,7 +35,7 @@ from jax import lax
 from cloud_server_tpu.config import InferConfig, ModelConfig
 from cloud_server_tpu.inference.sampling import sample_logits
 from cloud_server_tpu.models import transformer
-from cloud_server_tpu.ops import causal_attention, rms_norm, rope_frequencies
+from cloud_server_tpu.ops import causal_attention, rms_norm, rope_table
 
 
 class KVCache(NamedTuple):
@@ -84,7 +84,7 @@ def prefill(params, tokens: jnp.ndarray, cfg: ModelConfig, cache: KVCache,
     """
     b, p = tokens.shape
     max_len = cache.k.shape[2]
-    cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
+    cos, sin = rope_table(cfg, max_len)
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
     # honour cfg.attention_impl (flash for long prompts); decode keeps the
     # dense cache path since a single query can't use the blockwise kernel.
@@ -122,7 +122,7 @@ def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
     cache.length[i] (per-sequence — ragged batches are handled exactly)."""
     max_len = cache.k.shape[2]
     pos = cache.length  # (B,)
-    cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
+    cos, sin = rope_table(cfg, max_len)
     positions = pos[:, None]  # (B, 1)
 
     x = params["embed"]["tokens"].astype(cfg.dtype)[token[:, None]]  # (B,1,D)

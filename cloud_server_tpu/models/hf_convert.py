@@ -30,6 +30,7 @@ Reference parity note: view-sonic/Cloud-Server @ v0 is an empty tree
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Mapping
 
 import jax.numpy as jnp
@@ -38,8 +39,62 @@ import numpy as np
 from cloud_server_tpu.config import ModelConfig
 
 
+# Overriding these changes the parameter-tree shapes / semantics and can
+# only corrupt a conversion, so config_from_hf rejects them rather than
+# forwarding them into a reshape error deep inside params_from_hf.
+_STRUCTURAL_FIELDS = frozenset({
+    "vocab_size", "embed_dim", "num_layers", "num_heads", "num_kv_heads",
+    "head_dim", "mlp_dim", "tie_embeddings", "num_experts",
+    "rope_theta", "rope_scaling", "rope_scaling_factor",
+    "rope_low_freq_factor", "rope_high_freq_factor", "rope_original_max_len",
+})
+
+
+def _rope_fields_from_hf(hf_config: Any) -> dict:
+    """Map transformers' rope_scaling dict onto ModelConfig rope fields.
+
+    Supported: absent/default (no scaling), "linear", "llama3". Anything
+    else (yarn, dynamic, longrope...) raises — silently dropping the
+    schedule would serve wrong logits at every position."""
+    rs = getattr(hf_config, "rope_scaling", None)
+    if not rs:
+        return {}
+    kind = rs.get("rope_type", rs.get("type", "default"))
+    if kind in (None, "default"):
+        return {}
+    if kind == "linear":
+        return dict(rope_scaling="linear",
+                    rope_scaling_factor=float(rs["factor"]))
+    if kind == "llama3":
+        return dict(
+            rope_scaling="llama3",
+            rope_scaling_factor=float(rs["factor"]),
+            rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+            rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+            rope_original_max_len=int(
+                rs.get("original_max_position_embeddings", 8192)))
+    raise ValueError(
+        f"unsupported rope_scaling type {kind!r} in HF config — supported: "
+        "default/linear/llama3")
+
+
 def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
-    """Build a ModelConfig from a transformers LlamaConfig-like object."""
+    """Build a ModelConfig from a transformers LlamaConfig-like object.
+
+    `overrides` may adjust behavioral fields (dtype, attention_impl,
+    remat, max_seq_len, ...); structural fields that must match the
+    checkpoint tensors are rejected when they contradict the HF config.
+    Unsupported architecture variants (non-SiLU activation, attention/MLP
+    biases, exotic rope scaling) raise instead of converting silently
+    wrong."""
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise ValueError(f"unsupported hidden_act {act!r} (SwiGLU/SiLU only)")
+    for bias_field in ("attention_bias", "mlp_bias"):
+        if getattr(hf_config, bias_field, False):
+            raise ValueError(
+                f"unsupported {bias_field}=True — this framework's "
+                "LLaMA-family layers are bias-free")
     fields = dict(
         vocab_size=hf_config.vocab_size,
         embed_dim=hf_config.hidden_size,
@@ -55,7 +110,20 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
         norm_eps=float(hf_config.rms_norm_eps),
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
                                     False)),
+        **_rope_fields_from_hf(hf_config),
     )
+    # Structural fields the HF config doesn't mention still have a correct
+    # value for this checkpoint: the ModelConfig default (dense model, no
+    # rope scaling). Seed those so every structural override is compared
+    # against SOMETHING — `fields.get(key, val)` would vacuously accept
+    # e.g. num_experts=8 on a dense checkpoint.
+    defaults = {f.name: f.default for f in dataclasses.fields(ModelConfig)}
+    for key, val in overrides.items():
+        if key in _STRUCTURAL_FIELDS and val != fields.get(key, defaults[key]):
+            raise ValueError(
+                f"config override {key}={val!r} contradicts the checkpoint "
+                f"({fields.get(key, defaults[key])!r}) — structural fields "
+                "come from the HF config; drop the override")
     fields.update(overrides)
     return ModelConfig(**fields)
 
@@ -66,57 +134,64 @@ def _np(t) -> np.ndarray:
     return np.asarray(t, np.float32)
 
 
+# State-dict keys that are buffers/bookkeeping, not weights — safe to skip.
+_IGNORABLE_KEY_PARTS = ("rotary_emb", "position_ids", "masked_bias",
+                        "attn.bias")
+
+
 def params_from_hf(state_dict: Mapping[str, Any], cfg: ModelConfig,
                    dtype: str | None = None) -> dict:
     """Convert an HF LlamaForCausalLM state dict to this framework's
     parameter tree (leaves in `dtype`, default cfg.param_dtype).
 
-    Conversion is per-key lazy: each tensor is pulled from the (possibly
-    torch, possibly bf16) state dict and converted on use, so peak host
-    memory stays near one extra copy rather than a full f32 duplicate of
-    the checkpoint."""
+    Conversion runs one stacked tensor family at a time — each per-layer
+    stack is built, transposed, converted to a jnp leaf and its f32 numpy
+    intermediate freed before the next family starts — so peak host
+    memory is the source checkpoint + the growing output tree + ONE
+    f32 layer stack, not four attention stacks at once.
+
+    Every state-dict key must either be consumed or match a known
+    ignorable buffer pattern; leftovers (e.g. attention biases from a
+    checkpoint with attention_bias=True) raise instead of being silently
+    dropped."""
     L, D, H, KH, Dh = (cfg.num_layers, cfg.embed_dim, cfg.num_heads,
                        cfg.num_kv_heads, cfg.head_dim)
     out_dtype = jnp.dtype(dtype or cfg.param_dtype)
+    consumed: set[str] = set()
 
     def get(key: str) -> np.ndarray:
+        consumed.add(key)
         return _np(state_dict[key])
 
-    def stack(fmt: str) -> np.ndarray:
-        return np.stack([get(fmt.format(i)) for i in range(L)])
+    def stack(fmt: str, transform=None) -> jnp.ndarray:
+        arr = np.stack([get(fmt.format(i)) for i in range(L)])
+        if transform is not None:
+            arr = transform(arr)
+        return jnp.asarray(arr, out_dtype)
 
-    wq = stack("model.layers.{}.self_attn.q_proj.weight")  # (L, H*Dh, D)
-    wk = stack("model.layers.{}.self_attn.k_proj.weight")
-    wv = stack("model.layers.{}.self_attn.v_proj.weight")
-    wo = stack("model.layers.{}.self_attn.o_proj.weight")  # (L, D, H*Dh)
-
+    layers = {
+        "attn_norm": stack("model.layers.{}.input_layernorm.weight"),
+        "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight"),
+        # HF projections are (out, in); transpose then split the head dims.
+        "wq": stack("model.layers.{}.self_attn.q_proj.weight",
+                    lambda a: a.transpose(0, 2, 1).reshape(L, D, H, Dh)),
+        "wk": stack("model.layers.{}.self_attn.k_proj.weight",
+                    lambda a: a.transpose(0, 2, 1).reshape(L, D, KH, Dh)),
+        "wv": stack("model.layers.{}.self_attn.v_proj.weight",
+                    lambda a: a.transpose(0, 2, 1).reshape(L, D, KH, Dh)),
+        "wo": stack("model.layers.{}.self_attn.o_proj.weight",
+                    lambda a: a.transpose(0, 2, 1).reshape(L, H, Dh, D)),
+        "w_gate": stack("model.layers.{}.mlp.gate_proj.weight",
+                        lambda a: a.transpose(0, 2, 1)),
+        "w_up": stack("model.layers.{}.mlp.up_proj.weight",
+                      lambda a: a.transpose(0, 2, 1)),
+        "w_down": stack("model.layers.{}.mlp.down_proj.weight",
+                        lambda a: a.transpose(0, 2, 1)),
+    }
     params = {
         "embed": {"tokens": jnp.asarray(
             get("model.embed_tokens.weight"), out_dtype)},
-        "layers": {
-            "attn_norm": jnp.asarray(
-                stack("model.layers.{}.input_layernorm.weight"), out_dtype),
-            "mlp_norm": jnp.asarray(
-                stack("model.layers.{}.post_attention_layernorm.weight"),
-                out_dtype),
-            "wq": jnp.asarray(
-                wq.transpose(0, 2, 1).reshape(L, D, H, Dh), out_dtype),
-            "wk": jnp.asarray(
-                wk.transpose(0, 2, 1).reshape(L, D, KH, Dh), out_dtype),
-            "wv": jnp.asarray(
-                wv.transpose(0, 2, 1).reshape(L, D, KH, Dh), out_dtype),
-            "wo": jnp.asarray(
-                wo.transpose(0, 2, 1).reshape(L, H, Dh, D), out_dtype),
-            "w_gate": jnp.asarray(
-                stack("model.layers.{}.mlp.gate_proj.weight"
-                      ).transpose(0, 2, 1), out_dtype),
-            "w_up": jnp.asarray(
-                stack("model.layers.{}.mlp.up_proj.weight"
-                      ).transpose(0, 2, 1), out_dtype),
-            "w_down": jnp.asarray(
-                stack("model.layers.{}.mlp.down_proj.weight"
-                      ).transpose(0, 2, 1), out_dtype),
-        },
+        "layers": layers,
         "final_norm": {"scale": jnp.asarray(
             get("model.norm.weight"), out_dtype)},
     }
@@ -127,6 +202,20 @@ def params_from_hf(state_dict: Mapping[str, Any], cfg: ModelConfig,
                 "is False — pass a config with tie_embeddings=True")
         params["lm_head"] = {"kernel": jnp.asarray(
             get("lm_head.weight").T, out_dtype)}
+    else:
+        consumed.add("lm_head.weight")  # alias of the embedding when tied
+
+    leftover = sorted(
+        k for k in state_dict
+        if k not in consumed
+        and not any(part in k for part in _IGNORABLE_KEY_PARTS))
+    if leftover:
+        preview = ", ".join(leftover[:6])
+        raise ValueError(
+            f"{len(leftover)} unsupported weight(s) in checkpoint would be "
+            f"silently dropped: {preview}"
+            + (" ..." if len(leftover) > 6 else "")
+            + " — this architecture variant (biases?) is not supported")
     return params
 
 

@@ -25,7 +25,7 @@ from jax import lax
 
 from cloud_server_tpu.config import ModelConfig
 from cloud_server_tpu.models import transformer
-from cloud_server_tpu.ops import rms_norm, rope_frequencies
+from cloud_server_tpu.ops import rms_norm, rope_table
 
 Params = dict
 
@@ -194,7 +194,7 @@ def _moe_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn):
 
 def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
     """(B, S) -> (final-normed hidden (B, S, D), aux dict of router stats)."""
-    cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1], cfg.rope_theta)
+    cos, sin = rope_table(cfg, tokens.shape[1])
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
     x = transformer.constrain(x, ("batch", "sequence", None))
     attn_fn = transformer._get_attention_fn(cfg)

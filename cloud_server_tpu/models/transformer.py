@@ -23,7 +23,7 @@ from jax import lax
 
 from cloud_server_tpu.config import ModelConfig
 from cloud_server_tpu.ops import (apply_rope, causal_attention, rms_norm,
-                                  rope_frequencies, swiglu)
+                                  rope_table, swiglu)
 from cloud_server_tpu.parallel.sharding import constrain
 
 Params = dict
@@ -216,13 +216,24 @@ def _get_attention_fn(cfg: ModelConfig):
             return ring_attention_sharded(q, k, v, mesh)
 
         return ring_fn
+    if cfg.attention_impl == "ulysses":
+        from cloud_server_tpu.parallel.mesh import current_mesh
+        from cloud_server_tpu.parallel.ulysses import (
+            ulysses_attention_sharded)
+
+        mesh = current_mesh()
+
+        def ulysses_fn(q, k, v):
+            return ulysses_attention_sharded(q, k, v, mesh)
+
+        return ulysses_fn
     raise ValueError(f"unknown attention_impl: {cfg.attention_impl!r}")
 
 
 def forward_hidden(params: Params, tokens: jnp.ndarray,
                    cfg: ModelConfig) -> jnp.ndarray:
     """(B, S) int32 -> final-normed hidden states (B, S, D) in cfg.dtype."""
-    cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1], cfg.rope_theta)
+    cos, sin = rope_table(cfg, tokens.shape[1])
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
     # Anchor the residual stream to (batch, sequence, -) so that with
     # sp > 1 every per-position op (norms, MLP, fused CE) computes S/sp per

@@ -7,18 +7,70 @@ phase error compounds visibly at long context.
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 
 
+def _scale_inv_freq(inv_freq: jnp.ndarray, scaling: dict) -> jnp.ndarray:
+    """Frequency scaling for long-context checkpoints.
+
+    "linear": positions are interpolated — every frequency divided by
+    `factor` (the original Llama linear rope_scaling).
+    "llama3": Llama 3.1's band-wise scheme — wavelengths short relative to
+    the original context window keep their frequency, long wavelengths are
+    divided by `factor`, and the band between `high_freq_factor` and
+    `low_freq_factor` interpolates smoothly between the two.
+    """
+    kind = scaling["type"]
+    factor = float(scaling["factor"])
+    if kind == "linear":
+        return inv_freq / factor
+    if kind == "llama3":
+        low = float(scaling["low_freq_factor"])
+        high = float(scaling["high_freq_factor"])
+        orig = float(scaling["original_max_len"])
+        wavelen = 2.0 * math.pi / inv_freq
+        smooth = (orig / wavelen - low) / (high - low)
+        interp = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        return jnp.where(wavelen > orig / low, inv_freq / factor,
+                         jnp.where(wavelen < orig / high, inv_freq, interp))
+    raise ValueError(f"unknown rope scaling type: {kind!r}")
+
+
 def rope_frequencies(
-    head_dim: int, max_seq_len: int, theta: float = 10000.0
+    head_dim: int, max_seq_len: int, theta: float = 10000.0,
+    *, scaling: dict | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Return (cos, sin), each (max_seq_len, head_dim // 2), float32."""
+    """Return (cos, sin), each (max_seq_len, head_dim // 2), float32.
+
+    `scaling`: optional frequency-scaling spec, e.g.
+    {"type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+     "high_freq_factor": 4.0, "original_max_len": 8192} — see
+    `_scale_inv_freq`. Prefer `rope_table(cfg, S)` which reads it from
+    the ModelConfig.
+    """
     half = head_dim // 2
     inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    if scaling is not None:
+        inv_freq = _scale_inv_freq(inv_freq, scaling)
     pos = jnp.arange(max_seq_len, dtype=jnp.float32)
     angles = jnp.outer(pos, inv_freq)  # (S, half)
     return jnp.cos(angles), jnp.sin(angles)
+
+
+def rope_table(cfg, seq_len: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(cos, sin) tables from a ModelConfig — the single entry point the
+    models/engine use, so rope_scaling configs apply everywhere at once."""
+    scaling = None
+    if cfg.rope_scaling != "none":
+        scaling = {"type": cfg.rope_scaling,
+                   "factor": cfg.rope_scaling_factor,
+                   "low_freq_factor": cfg.rope_low_freq_factor,
+                   "high_freq_factor": cfg.rope_high_freq_factor,
+                   "original_max_len": cfg.rope_original_max_len}
+    return rope_frequencies(cfg.head_dim, seq_len, cfg.rope_theta,
+                            scaling=scaling)
 
 
 def apply_rope(

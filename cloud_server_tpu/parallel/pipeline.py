@@ -178,7 +178,7 @@ def make_pipelined_hidden(model_cfg, mesh: Mesh, num_microbatches: int,
     Embedding / final norm / head run replicated over pp (they are cheap
     relative to the stack); only the L-layer block scan is pipelined.
     """
-    from cloud_server_tpu.ops import rms_norm, rope_frequencies
+    from cloud_server_tpu.ops import rms_norm, rope_table
     from cloud_server_tpu.parallel.sharding import DEFAULT_RULES
 
     rules = rules or DEFAULT_RULES
@@ -194,8 +194,7 @@ def make_pipelined_hidden(model_cfg, mesh: Mesh, num_microbatches: int,
 
     def hidden(params, tokens):
         cfg = model_cfg
-        cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1],
-                                    cfg.rope_theta)
+        cos, sin = rope_table(cfg, tokens.shape[1])
         x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]  # (B, S, D)
         b = x.shape[0]
         mb = b // num_microbatches

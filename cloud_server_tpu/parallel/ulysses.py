@@ -1,0 +1,90 @@
+"""Ulysses (all-to-all) sequence/context parallelism over the `sp` axis.
+
+The second sequence-parallel scheme next to ring attention
+(`parallel/ring_attention.py`), selected with
+`ModelConfig(attention_impl="ulysses")`. Where ring attention keeps queries
+sequence-sharded and rotates kv chunks around the ring (n-1 ppermute hops,
+comm proportional to kv size * (n-1)), Ulysses re-shards: one all-to-all
+turns the sequence sharding into a *head* sharding, every device then runs
+ordinary dense causal attention over the FULL sequence for H/sp of the
+heads, and a second all-to-all restores the sequence sharding. Two
+collectives total, each moving S*H*Dh/sp per device — cheaper than the
+ring when sp is small relative to heads and S is moderate; the ring wins
+when S is huge (its live buffers stay S/sp-sized, Ulysses materialises the
+full S locally) or when sp exceeds the head count.
+
+All-to-all layout: with local q of shape (B, S/sp, H, Dh), splitting the
+head axis into sp chunks and concatenating received pieces along the
+sequence axis yields (B, S, H/sp, Dh); device i ends up with head-chunk i
+of every sequence chunk, in ring order, so the concatenated sequence is in
+global order and causal masking needs no position bookkeeping. The inverse
+all-to-all (split sequence, concat heads) restores the original layout.
+
+GQA: when sp divides the local kv-head count, k/v ride the same all-to-all
+(head-chunk boundaries then align with kv-group boundaries, since
+H_loc/sp = (KH_loc/sp) * q_per_kv). Otherwise kv heads are first repeated
+up to the q-head layout (MHA expansion) so chunks align trivially — the
+comm-optimal choice for KH_loc < sp anyway, where some replication is
+unavoidable.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from cloud_server_tpu.ops.attention import causal_attention
+from cloud_server_tpu.parallel import collectives
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      *, axis_name: str = "sp", scale: float | None = None):
+    """Causal GQA over a sequence sharded on `axis_name`. Call under shard_map.
+
+    q: (B, Sq_local, H, Dh); k, v: (B, Skv_local, KH, Dh) — the local
+    chunks, in ring order (device i holds positions
+    [i * Sq_local, (i+1) * Sq_local)). Returns (B, Sq_local, H, Dh).
+    """
+    sp = lax.axis_size(axis_name)
+    if sp == 1:
+        return causal_attention(q, k, v, scale=scale)
+    h, kh = q.shape[2], k.shape[2]
+    if h % sp:
+        raise ValueError(
+            f"ulysses attention needs local head count divisible by the "
+            f"sp axis: heads={h}, sp={sp}")
+
+    if kh % sp:
+        # MHA expansion: repeat kv head j into q heads [j*g, (j+1)*g) so
+        # head chunks align with q's after the all-to-all.
+        g = h // kh
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+
+    # sequence-sharded -> head-sharded: (B, S/sp, H, Dh) -> (B, S, H/sp, Dh)
+    to_heads = functools.partial(collectives.all_to_all, axis=axis_name,
+                                 split_axis=2, concat_axis=1)
+    q_full, k_full, v_full = to_heads(q), to_heads(k), to_heads(v)
+
+    out = causal_attention(q_full, k_full, v_full, scale=scale)
+
+    # head-sharded -> sequence-sharded: (B, S, H/sp, Dh) -> (B, S/sp, H, Dh)
+    return collectives.all_to_all(out, axis=axis_name,
+                                  split_axis=1, concat_axis=2)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, *, scale=None,
+                              batch_axes=("dp", "fsdp"), seq_axis="sp",
+                              head_axis="tp"):
+    """shard_map wrapper: full (B, S, H, Dh) arrays in, Ulysses attention
+    over the sp axis, full arrays out (still sharded by the same specs).
+    Drop-in alternative to `ring_attention_sharded`."""
+    qspec = P(batch_axes, seq_axis, head_axis, None)
+    fn = functools.partial(ulysses_attention, axis_name=seq_axis, scale=scale)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
+        check_vma=True)(q, k, v)

@@ -63,6 +63,69 @@ def test_config_mapping(tiny_llama):
     assert cfg.tie_embeddings is False
 
 
+def test_llama3_rope_scaling_logits_match(tiny_llama):
+    """A Llama 3.1-style rope_scaling config converts and reproduces the
+    transformers reference logits (validates _scale_inv_freq band math)."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-6, rope_theta=10000.0,
+        tie_word_embeddings=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32})
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, dtype="float32", param_dtype="float32",
+                         remat="none")
+    assert cfg.rope_scaling == "llama3" and cfg.rope_scaling_factor == 8.0
+    params = params_from_hf(model.state_dict(), cfg)
+    tokens = np.arange(48, dtype=np.int32)[None, :] % 128
+    ours = np.asarray(transformer.forward(
+        params, jax.numpy.asarray(tokens), cfg))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(tokens.astype(np.int64))
+                       ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=3e-4)
+
+
+def test_unsupported_rope_scaling_raises(tiny_llama):
+    hf_cfg, _ = tiny_llama
+    import copy
+    bad = copy.deepcopy(hf_cfg)
+    bad.rope_scaling = {"rope_type": "yarn", "factor": 4.0}
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(bad)
+
+
+def test_attention_bias_rejected(tiny_llama):
+    hf_cfg, _ = tiny_llama
+    import copy
+    bad = copy.deepcopy(hf_cfg)
+    bad.attention_bias = True
+    with pytest.raises(ValueError, match="attention_bias"):
+        config_from_hf(bad)
+
+
+def test_unconsumed_keys_rejected(tiny_llama):
+    """Bias weights in the state dict must raise, not be silently dropped."""
+    hf_cfg, model = tiny_llama
+    cfg = config_from_hf(hf_cfg)
+    sd = dict(model.state_dict())
+    sd["model.layers.0.self_attn.q_proj.bias"] = torch.zeros(32)
+    with pytest.raises(ValueError, match="unsupported weight"):
+        params_from_hf(sd, cfg)
+
+
+def test_structural_override_rejected(tiny_llama):
+    hf_cfg, _ = tiny_llama
+    with pytest.raises(ValueError, match="structural"):
+        config_from_hf(hf_cfg, num_layers=4)
+    # behavioral overrides still pass
+    cfg = config_from_hf(hf_cfg, dtype="float32", max_seq_len=32)
+    assert cfg.max_seq_len == 32
+
+
 def test_generate_cli_serves_hf_checkpoint(tmp_path, capsys, devices8):
     """--hf-checkpoint loads a local HF directory and serves it."""
     # vocab must cover the byte tokenizer (259)
