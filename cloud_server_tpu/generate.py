@@ -54,6 +54,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="decode steps per scheduler iteration (multi-token "
                    "scheduling; >1 amortises host sync at the cost of "
                    "admission latency)")
+    p.add_argument("--draft-config", metavar="JSON",
+                   help="speculative decoding: JSON config (model section) "
+                   "of a small draft model sharing the tokenizer; batch "
+                   "mode only")
+    p.add_argument("--draft-checkpoint-dir",
+                   help="draft model checkpoint (omit: random init — only "
+                   "useful for smoke tests)")
+    p.add_argument("--num-draft", type=int, default=4,
+                   help="draft tokens proposed per speculative round")
     from cloud_server_tpu.models.lora import add_lora_args
     add_lora_args(p)
     return p
@@ -192,6 +201,54 @@ def main(argv=None) -> None:
 
     encoded = [tok.encode(p, add_bos=args.add_bos and tok.bos_id is not None)
                or [0] for p in prompts]
+    if args.draft_config:
+        import jax
+        import numpy as np
+
+        from cloud_server_tpu.inference.speculative import (
+            speculative_generate)
+        with open(args.draft_config) as f:
+            draft_cfg = from_json(ModelConfig, json.load(f).get("model", {}))
+        if args.quantize:
+            raise SystemExit("--quantize + --draft-config not supported yet")
+        draft_params = load_params(draft_cfg, args.draft_checkpoint_dir,
+                                   None, args.seed + 1)
+        longest = max(len(e) for e in encoded)
+        # honour --max-len / the trained context window like the plain
+        # path: the cache must hold prompt + new tokens + the speculative
+        # window's overhang, so clamp max_new to what fits.
+        cap = args.max_len or model_cfg.max_seq_len
+        budget = cap - longest - args.num_draft - 1
+        if budget < 1:
+            raise SystemExit(
+                f"prompt ({longest}) + speculative window "
+                f"({args.num_draft + 1}) leaves no room to decode within "
+                f"max_len={cap}; raise --max-len or shorten the prompt")
+        max_new = min(args.max_new, budget)
+        if max_new < args.max_new:
+            print(f"[generate] clamping --max-new {args.max_new} -> "
+                  f"{max_new} to fit max_len={cap}", file=sys.stderr)
+            import dataclasses
+            infer_cfg = dataclasses.replace(infer_cfg,
+                                            max_decode_len=max_new)
+        padded = np.zeros((len(encoded), longest), np.int32)
+        lengths = np.asarray([len(e) for e in encoded], np.int32)
+        for i, e in enumerate(encoded):
+            padded[i, :len(e)] = e
+        toks = speculative_generate(
+            params, draft_params, jax.numpy.asarray(padded),
+            jax.random.key(args.seed), cfg=model_cfg, draft_cfg=draft_cfg,
+            infer_cfg=infer_cfg, num_draft=args.num_draft,
+            max_len=longest + max_new + args.num_draft + 1,
+            prompt_lengths=jax.numpy.asarray(lengths))
+        for prompt, row in zip(prompts, np.asarray(toks)):
+            row = list(row)
+            if infer_cfg.eos_token_id >= 0 and infer_cfg.eos_token_id in row:
+                row = row[:row.index(infer_cfg.eos_token_id)]
+            print(f"=== {prompt!r}")
+            print(tok.decode([t for t in row if t != infer_cfg.pad_token_id]))
+        return
+
     longest = max(len(e) for e in encoded)
     max_len = args.max_len or min(model_cfg.max_seq_len,
                                   longest + args.max_new)

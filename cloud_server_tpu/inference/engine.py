@@ -165,6 +165,46 @@ def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
     return logits, KVCache(k_all, v_all, cache.length + 1)
 
 
+def verify_step(params, tokens: jnp.ndarray, cfg: ModelConfig,
+                cache: KVCache) -> tuple[jnp.ndarray, KVCache]:
+    """Process a (B, K) window of tokens starting at each sequence's
+    current cache position in ONE forward pass, returning logits at every
+    window position — the target-model half of speculative decoding
+    (score K draft tokens for the price of one memory-bound pass).
+
+    Sequence i's window occupies positions [length[i], length[i] + K); its
+    kv entries are written into the cache, but `length` is NOT advanced —
+    the caller commits however many positions verification accepts (stale
+    entries beyond the commit point are masked by `kv_length` and
+    overwritten by later writes at the same positions, so rollback is just
+    "don't advance").
+
+    Returns (logits (B, K, V) f32, cache with entries written).
+    """
+    b, kk = tokens.shape
+    max_len = cache.k.shape[2]
+    cos, sin = rope_table(cfg, max_len)
+    pos = cache.length[:, None] + jnp.arange(kk)[None, :]  # (B, K)
+
+    x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]  # (B, K, D)
+    k_all, v_all = cache.k, cache.v
+    batch_idx = jnp.arange(b)
+    for layer_idx in range(cfg.num_layers):
+        lp = jax.tree.map(lambda w: w[layer_idx], params["layers"])
+        q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin, pos)
+        k_all = k_all.at[layer_idx, batch_idx[:, None], pos].set(k)
+        v_all = v_all.at[layer_idx, batch_idx[:, None], pos].set(v)
+        # q_positions give the in-window causal structure; kv_length masks
+        # both stale cache entries and the other sequences' longer windows.
+        o = causal_attention(q, k_all[layer_idx], v_all[layer_idx],
+                             q_positions=pos, kv_length=cache.length + kk)
+        x = transformer.attention_out(x, o, lp, cfg)
+        x = _mlp_apply(x, lp, cfg)
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    logits = transformer.unembed(x, params, cfg)  # (B, K, V)
+    return logits, KVCache(k_all, v_all, cache.length)
+
+
 # ---------------------------------------------------------------------------
 # Generate
 # ---------------------------------------------------------------------------

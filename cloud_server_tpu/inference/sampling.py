@@ -34,14 +34,43 @@ def _apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     return jnp.where(logits < cutoff, NEG_INF, logits)
 
 
-def sample_logits(logits: jnp.ndarray, rng: jax.Array,
-                  cfg: InferConfig) -> jnp.ndarray:
-    """logits: (B, V) f32 -> (B,) int32 sampled token ids."""
-    if cfg.temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _filtered_logits(logits: jnp.ndarray, cfg: InferConfig) -> jnp.ndarray:
+    """Temperature / top-k / top-p filter chain. Single source of truth:
+    `sample_logits` draws categorically from these, `sampling_probs`
+    softmaxes them — keeping speculative decoding's output-distribution
+    exactness structural rather than hand-synced. Callers handle
+    temperature <= 0 (greedy) before calling."""
     x = logits / cfg.temperature
     if cfg.top_k > 0:
         x = _apply_top_k(x, cfg.top_k)
     if cfg.top_p < 1.0:
         x = _apply_top_p(x, cfg.top_p)
-    return jax.random.categorical(rng, x, axis=-1).astype(jnp.int32)
+    return x
+
+
+def sample_logits(logits: jnp.ndarray, rng: jax.Array,
+                  cfg: InferConfig) -> jnp.ndarray:
+    """logits: (B, V) f32 -> (B,) int32 sampled token ids."""
+    if cfg.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, _filtered_logits(logits, cfg), axis=-1).astype(jnp.int32)
+
+
+def sampling_probs(logits: jnp.ndarray, cfg: InferConfig) -> jnp.ndarray:
+    """The actual distribution `sample_logits` draws from: (..., V) f32
+    probabilities after temperature / top-k / top-p (one-hot argmax for
+    greedy). Speculative decoding's accept/residual rule needs these
+    explicitly — acceptance must be measured against the FILTERED
+    distribution or the output distribution would not match plain
+    sampling."""
+    if cfg.temperature <= 0.0:
+        return jax.nn.one_hot(jnp.argmax(logits, axis=-1), logits.shape[-1],
+                              dtype=jnp.float32)
+    return jax.nn.softmax(_filtered_logits(logits, cfg), axis=-1)
+
+
+def sample_from_probs(probs: jnp.ndarray, rng: jax.Array) -> jnp.ndarray:
+    """Categorical draw from (..., V) probabilities -> (...,) int32."""
+    return jax.random.categorical(
+        rng, jnp.log(jnp.maximum(probs, 1e-30)), axis=-1).astype(jnp.int32)

@@ -1,0 +1,194 @@
+"""Speculative decoding: a small draft model proposes G tokens per round,
+the target model scores the whole window in one `verify_step` pass, and a
+rejection-sampling rule commits an accepted prefix plus one corrective
+token.
+
+Output-distribution exactness: acceptance follows the standard
+speculative-sampling rule — draft token d with draft probability q(d) and
+target probability p(d) is accepted with prob min(1, p(d)/q(d)); on first
+rejection the corrective token is drawn from normalize(max(p - q, 0)); if
+all G drafts survive, a bonus token is drawn from the target's distribution
+at the window's last position. Both p and q are the *post-filter* sampling
+distributions (`sampling.sampling_probs`), so temperature/top-k/top-p
+semantics match plain `generate`; at temperature 0 both collapse to
+one-hots and the rule reduces to exact-match greedy — speculative greedy
+output is identical to `generate`'s token-for-token.
+
+Why this is the right shape for TPU decode: decode is HBM-bound (the full
+weight set streams per token), so scoring G+1 positions in one pass costs
+barely more than scoring one. Wall-clock per committed token drops by
+roughly the mean accepted length; everything (draft scan, verify, accept,
+commit, output scatter) runs inside ONE jitted `lax.while_loop` with static
+shapes — no host round-trip per round.
+
+Cache discipline — both models keep the invariant "at round start, every
+committed token EXCEPT the last has been processed into the cache":
+  * the draft runs G+1 decode steps — the last one exists only to process
+    its own G-th proposal so that when everything is accepted its cache is
+    already caught up; its sample is discarded.
+  * `verify_step` writes the window's kv entries but does not advance
+    `length`; the commit just advances each sequence's length by the
+    number of committed tokens. Stale entries past the commit point are
+    masked by `kv_length` and overwritten by the next round's writes at
+    the same positions — rollback is free.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cloud_server_tpu.config import InferConfig, ModelConfig
+from cloud_server_tpu.inference.engine import (
+    KVCache, decode_step, init_cache, prefill, verify_step)
+from cloud_server_tpu.inference.sampling import (
+    sample_from_probs, sampling_probs)
+
+
+def _accept_drafts(drafts, q_probs, p_probs, rng):
+    """Vectorised accept/residual rule.
+
+    drafts: (B, G) proposed tokens; q_probs: (B, G, V) draft sampling
+    distributions; p_probs: (B, G+1, V) target sampling distributions
+    (position j scores drafts[:, j]; position G is the bonus position).
+
+    Returns (n_accepted (B,) int32 in [0, G], corrective token x (B,)).
+    """
+    b, g = drafts.shape
+    rng_u, rng_x = jax.random.split(rng)
+    batch_idx = jnp.arange(b)
+
+    q_d = jnp.take_along_axis(q_probs, drafts[..., None], axis=-1)[..., 0]
+    p_d = jnp.take_along_axis(p_probs[:, :g], drafts[..., None],
+                              axis=-1)[..., 0]
+    u = jax.random.uniform(rng_u, (b, g))
+    accept = u * jnp.maximum(q_d, 1e-30) < p_d  # u < min(1, p/q)
+    prefix = jnp.cumprod(accept.astype(jnp.int32), axis=-1)
+    n_acc = prefix.sum(axis=-1)  # (B,) in [0, G]
+
+    # Residual at the first rejected position; when n_acc == G there is no
+    # rejection and the "residual" is the bonus position's target
+    # distribution unmodified (q contribution zeroed).
+    p_r = p_probs[batch_idx, n_acc]  # (B, V)
+    q_pad = jnp.concatenate([q_probs, jnp.zeros_like(q_probs[:, :1])],
+                            axis=1)
+    q_r = jnp.where((n_acc < g)[:, None], q_pad[batch_idx, n_acc], 0.0)
+    residual = jnp.maximum(p_r - q_r, 0.0)
+    # If float round-off leaves residual empty, fall back to p itself.
+    bad = residual.sum(-1, keepdims=True) <= 0.0
+    residual = jnp.where(bad, p_r, residual)
+    x = sample_from_probs(residual, rng_x)
+    return n_acc, x
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "draft_cfg", "infer_cfg", "num_draft",
+                     "max_len"))
+def speculative_generate(params, draft_params, prompt: jnp.ndarray,
+                         rng: jax.Array, *, cfg: ModelConfig,
+                         draft_cfg: ModelConfig, infer_cfg: InferConfig,
+                         num_draft: int = 4, max_len: int | None = None,
+                         prompt_lengths: jnp.ndarray | None = None
+                         ) -> jnp.ndarray:
+    """Speculative counterpart of `engine.generate` — same contract:
+    prompt (B, P) int32 right-padded (pass prompt_lengths when ragged),
+    returns (B, max_decode_len) int32 with pad after eos. The draft model
+    must share the target's tokenizer/vocab; `num_draft` (G) proposals are
+    scored per round.
+    """
+    b, p = prompt.shape
+    g = num_draft
+    n_new = infer_cfg.max_decode_len
+    pad = infer_cfg.pad_token_id
+    # + g + 1 slack: the final round's window may overhang the output.
+    max_len = max_len or (p + n_new + g + 1)
+    if max_len < p + n_new + g + 1:
+        raise ValueError(
+            f"max_len={max_len} < prompt ({p}) + max_decode_len ({n_new}) "
+            f"+ window slack ({g + 1}); the cache would silently wrap")
+
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = prefill(params, prompt, cfg, cache, prompt_lengths)
+    d_cache = init_cache(draft_cfg, b, max_len)
+    _, d_cache = prefill(draft_params, prompt, draft_cfg, d_cache,
+                         prompt_lengths)
+
+    rng, rng0 = jax.random.split(rng)
+    t_prev = sample_from_probs(sampling_probs(logits, infer_cfg), rng0)
+    done0 = t_prev == infer_cfg.eos_token_id
+    out = jnp.full((b, n_new + g + 1), pad, jnp.int32)
+    # the eos itself is emitted (matching generate); only LATER tokens pad
+    out = out.at[:, 0].set(t_prev)
+    # token 0 comes from prefill logits, mirroring `generate`
+    n_emit0 = jnp.ones((b,), jnp.int32)
+    batch_idx = jnp.arange(b)
+    j = jnp.arange(g + 1)[None, :]  # (1, G+1)
+
+    def round_body(state):
+        rnd, rng, t_prev, done, n_emit, out, cache, d_cache = state
+        rng, r_draft, r_acc = jax.random.split(
+            jax.random.fold_in(rng, rnd), 3)
+
+        # --- draft: G+1 decode steps (see module docstring) ---
+        def d_step(carry, rng_t):
+            tok, dc = carry
+            dlogits, dc = decode_step(draft_params, tok, draft_cfg, dc)
+            qp = sampling_probs(dlogits, infer_cfg)
+            nxt = sample_from_probs(qp, rng_t)
+            return (nxt, dc), (nxt, qp)
+
+        (_, d_cache2), (draft_toks, q_probs) = lax.scan(
+            d_step, (t_prev, d_cache), jax.random.split(r_draft, g + 1))
+        drafts = draft_toks[:g].T  # (B, G)
+        q_probs = q_probs[:g].transpose(1, 0, 2)  # (B, G, V)
+
+        # --- verify the whole window in one target pass ---
+        window = jnp.concatenate([t_prev[:, None], drafts], axis=1)
+        vlogits, cache2 = verify_step(params, window, cfg, cache)
+        p_probs = sampling_probs(vlogits, infer_cfg)  # (B, G+1, V)
+
+        n_acc, x = _accept_drafts(drafts, q_probs, p_probs, r_acc)
+
+        # --- commit d_1..d_{n_acc} then x, truncated at the first eos ---
+        drafts_x = jnp.concatenate([drafts, x[:, None]], axis=1)  # (B,G+1)
+        committed = jnp.where(
+            j < n_acc[:, None], drafts_x,
+            jnp.where(j == n_acc[:, None], x[:, None], pad))
+        is_eos = committed == infer_cfg.eos_token_id
+        first_eos = jnp.argmax(is_eos, axis=1)
+        has_eos = is_eos.any(axis=1)
+        count = jnp.where(has_eos, jnp.minimum(n_acc + 1, first_eos + 1),
+                          n_acc + 1)
+        count = jnp.where(done, 0, count)
+        emit = jnp.where(j < count[:, None], committed, pad)
+
+        # scatter into each sequence's next output slots; writes past
+        # `count` land on not-yet-filled pad slots (harmless), writes past
+        # the buffer drop.
+        cols = n_emit[:, None] + j  # (B, G+1)
+        out2 = out.at[batch_idx[:, None], cols].set(emit, mode="drop")
+
+        new_len = cache.length + count
+        cache3 = KVCache(cache2.k, cache2.v, new_len)
+        d_cache3 = KVCache(d_cache2.k, d_cache2.v, new_len)
+        done2 = done | (has_eos & (first_eos < count))
+        n_emit2 = n_emit + count
+        last_idx = jnp.maximum(count - 1, 0)
+        t_next = jnp.where(count > 0, committed[batch_idx, last_idx],
+                           t_prev)
+        return (rnd + 1, rng, t_next, done2, n_emit2, out2, cache3,
+                d_cache3)
+
+    def cond(state):
+        rnd, _, _, done, n_emit, *_ = state
+        # every active round commits >= 1 token, so n_new rounds suffice
+        return (rnd < n_new) & jnp.any(~done & (n_emit < n_new))
+
+    state = (jnp.int32(0), rng, t_prev, done0, n_emit0, out, cache,
+             d_cache)
+    state = lax.while_loop(cond, round_body, state)
+    return state[5][:, :n_new]

@@ -34,6 +34,30 @@ def test_tokenize_train_generate_pipeline(tmp_path, capsys, devices8):
     assert "efgh" in out.rsplit("'abcd'", 1)[1]
 
 
+def test_generate_speculative_cli(tmp_path, capsys, devices8):
+    """--draft-config routes batch generation through speculative decoding
+    and (greedy) must produce the same text as the plain path."""
+    from cloud_server_tpu.generate import main as generate_main
+
+    model = {"vocab_size": 259, "embed_dim": 32, "num_layers": 2,
+             "num_heads": 4, "num_kv_heads": 2, "head_dim": 8,
+             "mlp_dim": 64, "max_seq_len": 128, "dtype": "float32",
+             "param_dtype": "float32", "remat": "none"}
+    draft = dict(model, embed_dim=16, num_layers=1, num_heads=2, mlp_dim=32)
+    (tmp_path / "cfg.json").write_text(json.dumps({"model": model}))
+    (tmp_path / "draft.json").write_text(json.dumps({"model": draft}))
+
+    base_args = ["--config", str(tmp_path / "cfg.json"),
+                 "--prompt", "abcd", "--max-new", "8", "--temperature", "0"]
+    generate_main(base_args)
+    plain = capsys.readouterr().out
+    generate_main(base_args + ["--draft-config", str(tmp_path / "draft.json"),
+                               "--num-draft", "3"])
+    spec = capsys.readouterr().out
+    assert "'abcd'" in spec
+    assert spec.rsplit("'abcd'", 1)[1] == plain.rsplit("'abcd'", 1)[1]
+
+
 def test_generate_quantized(tmp_path, capsys, devices8):
     """--quantize serves int8 weights end-to-end through the CLI."""
     from cloud_server_tpu.generate import main as generate_main

@@ -1,0 +1,181 @@
+"""Speculative decoding: exactness vs `generate`, acceptance behavior,
+ragged prompts, eos handling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cloud_server_tpu.config import InferConfig, ModelConfig
+from cloud_server_tpu.inference.engine import generate
+from cloud_server_tpu.inference.speculative import (
+    _accept_drafts, speculative_generate)
+from cloud_server_tpu.models import transformer
+
+TARGET = ModelConfig(
+    vocab_size=64, embed_dim=32, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=8, mlp_dim=64, max_seq_len=512, dtype="float32",
+    param_dtype="float32", remat="none")
+DRAFT = ModelConfig(
+    vocab_size=64, embed_dim=16, num_layers=1, num_heads=2, num_kv_heads=2,
+    head_dim=8, mlp_dim=32, max_seq_len=512, dtype="float32",
+    param_dtype="float32", remat="none")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(TARGET, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return transformer.init_params(DRAFT, jax.random.key(1))
+
+
+def _greedy(n):
+    return InferConfig(max_decode_len=n, temperature=0.0, eos_token_id=-1,
+                       pad_token_id=0)
+
+
+def test_greedy_exact_vs_generate(params, draft_params):
+    """Greedy speculative output must be token-identical to plain greedy
+    generate, whatever the draft model proposes."""
+    icfg = _greedy(24)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(1, 64, (2, 8)), jnp.int32)
+    want = generate(params, prompt, jax.random.key(2), cfg=TARGET,
+                    infer_cfg=icfg)
+    got = speculative_generate(
+        params, draft_params, prompt, jax.random.key(3), cfg=TARGET,
+        draft_cfg=DRAFT, infer_cfg=icfg, num_draft=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_greedy_exact_self_draft(params):
+    """Draft == target: every proposal is accepted and output still
+    matches plain generate."""
+    icfg = _greedy(16)
+    prompt = jnp.asarray([[3, 7, 11, 2]], jnp.int32)
+    want = generate(params, prompt, jax.random.key(2), cfg=TARGET,
+                    infer_cfg=icfg)
+    got = speculative_generate(
+        params, params, prompt, jax.random.key(3), cfg=TARGET,
+        draft_cfg=TARGET, infer_cfg=icfg, num_draft=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_ragged_prompts_greedy(params, draft_params):
+    icfg = _greedy(12)
+    p1 = jnp.asarray([[5, 9, 3, 17, 6, 2]], jnp.int32)
+    p2 = jnp.asarray([[8, 4, 1]], jnp.int32)
+    want1 = generate(params, p1, jax.random.key(0), cfg=TARGET,
+                     infer_cfg=icfg)
+    want2 = generate(params, p2, jax.random.key(0), cfg=TARGET,
+                     infer_cfg=icfg)
+    ragged = jnp.asarray([[5, 9, 3, 17, 6, 2], [8, 4, 1, 0, 0, 0]],
+                         jnp.int32)
+    got = speculative_generate(
+        params, draft_params, ragged, jax.random.key(1), cfg=TARGET,
+        draft_cfg=DRAFT, infer_cfg=icfg, num_draft=4,
+        prompt_lengths=jnp.asarray([6, 3], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want1[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want2[0]))
+
+
+def test_eos_stops_and_pads(params, draft_params):
+    """Force eos: whichever token greedy emits first becomes the eos id;
+    the rest of the row must be pad."""
+    icfg = _greedy(16)
+    prompt = jnp.asarray([[3, 1, 4]], jnp.int32)
+    base = np.asarray(generate(params, prompt, jax.random.key(0),
+                               cfg=TARGET, infer_cfg=icfg))
+    eos = int(base[0, 2])  # third emitted token
+    icfg_eos = InferConfig(max_decode_len=16, temperature=0.0,
+                           eos_token_id=eos, pad_token_id=0)
+    got = np.asarray(speculative_generate(
+        params, draft_params, prompt, jax.random.key(1), cfg=TARGET,
+        draft_cfg=DRAFT, infer_cfg=icfg_eos, num_draft=4))
+    want = np.asarray(generate(params, prompt, jax.random.key(0),
+                               cfg=TARGET, infer_cfg=icfg_eos))
+    np.testing.assert_array_equal(got, want)
+    # eos itself is emitted, everything after is pad
+    eos_pos = list(got[0]).index(eos)
+    assert all(t == 0 for t in got[0][eos_pos + 1:])
+
+
+def test_first_token_eos_matches_generate(params, draft_params):
+    """eos as the very first sampled token must be emitted (not padded
+    away) — token-identical to plain generate."""
+    icfg0 = _greedy(8)
+    prompt = jnp.asarray([[3, 1, 4]], jnp.int32)
+    base = np.asarray(generate(params, prompt, jax.random.key(0),
+                               cfg=TARGET, infer_cfg=icfg0))
+    eos = int(base[0, 0])
+    icfg = InferConfig(max_decode_len=8, temperature=0.0, eos_token_id=eos,
+                       pad_token_id=0)
+    want = np.asarray(generate(params, prompt, jax.random.key(0),
+                               cfg=TARGET, infer_cfg=icfg))
+    got = np.asarray(speculative_generate(
+        params, draft_params, prompt, jax.random.key(1), cfg=TARGET,
+        draft_cfg=DRAFT, infer_cfg=icfg, num_draft=3))
+    np.testing.assert_array_equal(got, want)
+    assert got[0, 0] == eos and (got[0, 1:] == 0).all()
+
+
+def test_temperature_runs_and_tokens_valid(params, draft_params):
+    icfg = InferConfig(max_decode_len=20, temperature=0.8, top_k=20,
+                       eos_token_id=-1, pad_token_id=0)
+    prompt = jnp.asarray([[3, 7], [9, 2]], jnp.int32)
+    got = np.asarray(speculative_generate(
+        params, draft_params, prompt, jax.random.key(5), cfg=TARGET,
+        draft_cfg=DRAFT, infer_cfg=icfg, num_draft=4))
+    assert got.shape == (2, 20)
+    assert (got >= 0).all() and (got < 64).all()
+
+
+def test_accept_rule_identical_dists_accepts_all():
+    """q == p => acceptance prob min(1, p/q) = 1: every draft survives and
+    the corrective token comes from the bonus distribution."""
+    b, g, v = 2, 3, 8
+    rng = jax.random.key(0)
+    probs = jax.nn.softmax(
+        jax.random.normal(jax.random.key(1), (b, g + 1, v)))
+    drafts = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    n_acc, x = _accept_drafts(drafts, probs[:, :g], probs, rng)
+    np.testing.assert_array_equal(np.asarray(n_acc), [g, g])
+    assert ((np.asarray(x) >= 0) & (np.asarray(x) < v)).all()
+
+
+def test_accept_rule_zero_target_prob_rejects_first():
+    """p(d_1) == 0 => first draft must be rejected (n_acc == 0) and the
+    corrective sample drawn from p - q restricted to p's support."""
+    b, g, v = 1, 2, 8
+    q = jnp.full((b, g, v), 1.0 / v)
+    p = jnp.zeros((b, g + 1, v)).at[:, :, 7].set(1.0)
+    drafts = jnp.asarray([[0, 1]], jnp.int32)  # p(0) = 0
+    n_acc, x = _accept_drafts(drafts, q, p, jax.random.key(0))
+    assert int(n_acc[0]) == 0
+    assert int(x[0]) == 7
+
+
+def test_distribution_preserved_single_step():
+    """Empirical check of the accept/residual rule: with G=1, the law of
+    the committed first token must equal the target distribution p
+    regardless of the draft q."""
+    v = 4
+    p = jnp.asarray([0.5, 0.25, 0.125, 0.125])
+    q = jnp.asarray([0.125, 0.125, 0.25, 0.5])  # deliberately mismatched
+    n = 4000
+    keys = jax.random.split(jax.random.key(0), n)
+
+    def one(key):
+        kd, ka = jax.random.split(key)
+        d = jax.random.categorical(kd, jnp.log(q))
+        n_acc, x = _accept_drafts(
+            d[None, None].astype(jnp.int32), q[None, None],
+            jnp.stack([p, p])[None], ka)
+        return jnp.where(n_acc[0] > 0, d, x[0])
+
+    toks = np.asarray(jax.vmap(one)(keys))
+    freq = np.bincount(toks, minlength=v) / n
+    np.testing.assert_allclose(freq, np.asarray(p), atol=0.03)
