@@ -115,6 +115,10 @@ class TrainConfig:
     seed: int = 0
     moe_aux_loss_coef: float = 0.01
     moe_router_z_coef: float = 0.0
+    # Exponential moving average of params (0 = disabled). The EMA tree
+    # rides inside the optimizer state (sharded + checkpointed for free);
+    # extract with training.optim.ema_params(state.opt_state).
+    ema_decay: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

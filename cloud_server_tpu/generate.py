@@ -46,6 +46,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--quantize", action="store_true",
                    help="serve with int8 weight-only quantization (halves "
                    "the weight bytes streamed per decode step)")
+    p.add_argument("--ema", action="store_true",
+                   help="serve the EMA-averaged weights from a checkpoint "
+                   "trained with ema_decay > 0 (reads the checkpoint's "
+                   "'ema' item — one params-sized restore)")
     p.add_argument("--serve-http", type=int, metavar="PORT", default=None,
                    help="instead of batch generation, run the continuous-"
                    "batching server behind an HTTP streaming endpoint "
@@ -151,7 +155,26 @@ def main(argv=None) -> None:
                     f"recorded LoRA config {saved}; drop the flags (the "
                     "sidecar is used automatically)")
             lcfg = saved
-    if hf_params is not None:
+    if args.ema:
+        if hf_params is not None or lcfg is not None:
+            raise SystemExit("--ema applies to framework checkpoints "
+                             "without LoRA flags")
+        if not args.checkpoint_dir:
+            raise SystemExit("--ema needs --checkpoint-dir")
+        from cloud_server_tpu.config import MeshConfig
+        from cloud_server_tpu.models import transformer
+        from cloud_server_tpu.parallel.mesh import make_mesh
+        from cloud_server_tpu.training.checkpoint import restore_ema_params
+        moe_module = None
+        if model_cfg.num_experts >= 2:
+            from cloud_server_tpu.models import moe as moe_module
+        try:
+            params = restore_ema_params(
+                args.checkpoint_dir, model_cfg, make_mesh(MeshConfig()),
+                step=args.step, loss_fn_module=moe_module or transformer)
+        except FileNotFoundError as e:
+            raise SystemExit(str(e))
+    elif hf_params is not None:
         params = hf_params
     elif lcfg is not None:
         if model_cfg.num_experts >= 2:
@@ -178,6 +201,10 @@ def main(argv=None) -> None:
         pad_token_id=tok.pad_id or 0)
 
     if args.serve_http is not None:
+        if args.draft_config:
+            raise SystemExit(
+                "--draft-config is batch-mode only; --serve-http would "
+                "silently serve without speculation")
         from cloud_server_tpu.inference.http_server import HttpFrontend
         max_len = args.max_len or model_cfg.max_seq_len
         srv = InferenceServer(params, model_cfg, infer_cfg, max_slots=8,
@@ -211,8 +238,12 @@ def main(argv=None) -> None:
             draft_cfg = from_json(ModelConfig, json.load(f).get("model", {}))
         if args.quantize:
             raise SystemExit("--quantize + --draft-config not supported yet")
+        draft_module = None
+        if draft_cfg.num_experts >= 2:
+            from cloud_server_tpu.models import moe as draft_module
         draft_params = load_params(draft_cfg, args.draft_checkpoint_dir,
-                                   None, args.seed + 1)
+                                   None, args.seed + 1,
+                                   loss_fn_module=draft_module)
         longest = max(len(e) for e in encoded)
         # honour --max-len / the trained context window like the plain
         # path: the cache must hold prompt + new tokens + the speculative
@@ -245,8 +276,13 @@ def main(argv=None) -> None:
             row = list(row)
             if infer_cfg.eos_token_id >= 0 and infer_cfg.eos_token_id in row:
                 row = row[:row.index(infer_cfg.eos_token_id)]
+            # only TRAILING pads are padding; a mid-stream token that
+            # happens to equal pad_token_id is real output (byte 0 for the
+            # byte tokenizer) and the plain path prints it
+            while row and row[-1] == infer_cfg.pad_token_id:
+                row.pop()
             print(f"=== {prompt!r}")
-            print(tok.decode([t for t in row if t != infer_cfg.pad_token_id]))
+            print(tok.decode(row))
         return
 
     longest = max(len(e) for e in encoded)

@@ -19,6 +19,15 @@ flash kernel (`ops/flash_attention.py`); parity with the XLA reference
 (`ops/attention.py::causal_attention`) is tested to 2e-2 in bf16 and 2e-5
 in f32.
 
+When to prefer this over the XLA path (measured on TPU v5e, 2026-07):
+with many kv heads (MHA-style, e.g. KH=16, Dh=64) the per-block VMEM cap
+shrinks block_s and XLA's fused batched matmul wins (~25% faster at the
+B=8, S=1024 serving shape — see bench.py decode extras); with few kv
+heads (GQA, KH<=4) blocks stay large and this kernel matches or beats
+XLA, increasingly so at long context. Serving configs keep
+`decode_attention_impl="xla"` for MHA checkpoints and "pallas" for
+strongly-GQA ones.
+
 Forward-only by design — decode never backprops.
 """
 

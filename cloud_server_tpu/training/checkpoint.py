@@ -37,7 +37,8 @@ def abstract_train_state(model_cfg: ModelConfig, train_cfg: TrainConfig,
     This is the `target` a sharded restore needs: shape/dtype say *what* to
     read, the attached NamedSharding says *where* each shard lands.
     """
-    shardings = state_shardings(model_cfg, mesh, rules, loss_fn_module)
+    shardings = state_shardings(model_cfg, mesh, rules, loss_fn_module,
+                                train_cfg=train_cfg)
     opt = optimizer_for_module(train_cfg, model_cfg, loss_fn_module)
 
     def init_fn(rng):
@@ -74,13 +75,39 @@ class Checkpointer:
     def save(self, state: TrainState, *, metrics: dict | None = None,
              force: bool = False) -> bool:
         """Save `state` at its own step counter. Returns False when skipped
-        (off-cadence for save_interval_steps, or step already saved)."""
+        (off-cadence for save_interval_steps, or step already saved).
+
+        When the optimizer tracks a param EMA (TrainConfig.ema_decay > 0),
+        the EMA tree is ALSO written as its own checkpoint item ("ema",
+        next to the usual "default") so serving can restore just that one
+        params-sized tree — the cost of one duplicated tree on disk buys
+        an eval/serve path that never touches optimizer moments."""
+        import sys
+
+        from cloud_server_tpu.training.optim import ema_params
         step = int(jax.device_get(state.step))
         if step in self._mngr.all_steps():
             return False  # even force=True must not collide with a done save
-        return self._mngr.save(
-            step, args=ocp.args.StandardSave(state), metrics=metrics,
-            force=force)
+        ema = ema_params(state.opt_state)
+        if ema is None:
+            return self._mngr.save(step, args=ocp.args.StandardSave(state),
+                                   metrics=metrics, force=force)
+        try:
+            return self._mngr.save(
+                step, args=ocp.args.Composite(
+                    default=ocp.args.StandardSave(state),
+                    ema=ocp.args.StandardSave(ema)),
+                metrics=metrics, force=force)
+        except ValueError:
+            # The manager locked into single-item mode from pre-EMA steps
+            # already on disk (resuming an old run with ema newly enabled):
+            # keep checkpointing the state; the separate ema item resumes
+            # at the next fresh directory.
+            print("[checkpoint] directory predates the 'ema' item; saving "
+                  "state only (ema still restorable via the full state)",
+                  file=sys.stderr)
+            return self._mngr.save(step, args=ocp.args.StandardSave(state),
+                                   metrics=metrics, force=force)
 
     # -- restore ------------------------------------------------------------
 
@@ -93,7 +120,15 @@ class Checkpointer:
             if step is None:
                 raise FileNotFoundError(
                     f"no checkpoint found under {self._mngr.directory}")
-        return self._mngr.restore(step, args=ocp.args.StandardRestore(target))
+        try:
+            # single-item layout (no ema item saved)
+            return self._mngr.restore(
+                step, args=ocp.args.StandardRestore(target))
+        except ValueError:
+            # named-items layout (state under "default", ema alongside)
+            return self._mngr.restore(
+                step, args=ocp.args.Composite(
+                    default=ocp.args.StandardRestore(target)))["default"]
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -151,6 +186,41 @@ def restore_params(checkpoint_dir: str | os.PathLike, model_cfg: ModelConfig,
                                         restore_args=restore_args,
                                         partial_restore=True))
     return out["params"]
+
+
+def restore_ema_params(checkpoint_dir: str | os.PathLike,
+                       model_cfg: ModelConfig, mesh, *,
+                       step: int | None = None, rules=DEFAULT_RULES,
+                       loss_fn_module=transformer):
+    """Sharded restore of the EMA param tree — the "ema" item
+    `Checkpointer.save` writes when TrainConfig.ema_decay > 0. One
+    params-sized read; no optimizer-moment or raw-param IO. The tree is
+    float32 (the EMA accumulator dtype) and drop-in wherever params go
+    (forwards cast to cfg.dtype at use)."""
+    from functools import partial
+
+    directory = os.path.abspath(os.fspath(checkpoint_dir))
+    if step is None:
+        steps = ocp.utils.checkpoint_steps(directory)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint found under {directory}")
+        step = max(steps)
+    item_dir = os.path.join(directory, str(step), "ema")
+    if not os.path.isdir(item_dir):
+        raise FileNotFoundError(
+            f"checkpoint step {step} has no 'ema' item — was the run "
+            "trained with TrainConfig.ema_decay > 0 (and saved by this "
+            "version)?")
+
+    logical = loss_fn_module.param_logical_axes(model_cfg)
+    shardings = logical_to_sharding(logical, mesh, rules)
+    shapes = jax.eval_shape(partial(loss_fn_module.init_params, model_cfg),
+                            jax.random.key(0))
+    target = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, jnp.float32, sharding=sh),
+        shapes, shardings)
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        return ckptr.restore(item_dir, args=ocp.args.StandardRestore(target))
 
 
 def restore_or_init(ckpt: Checkpointer, model_cfg: ModelConfig,

@@ -172,6 +172,15 @@ def train_loop(model_cfg: ModelConfig, train_cfg: TrainConfig,
                 eval_metrics = evaluate(
                     state.params, iter(eval_loader), eval_step,
                     max_batches=loop_cfg.eval_batches)
+                from cloud_server_tpu.training.optim import ema_params
+                averaged = ema_params(state.opt_state)
+                if averaged is not None:
+                    eval_loader.load_state_dict(
+                        {"epoch": 0, "batch_in_epoch": 0})
+                    eval_metrics.update({
+                        f"ema_{k}": v for k, v in evaluate(
+                            averaged, iter(eval_loader), eval_step,
+                            max_batches=loop_cfg.eval_batches).items()})
                 logger.log(step, eval_metrics)
                 _beat_hooks(hooks)
 

@@ -117,16 +117,71 @@ def reference_adamw(cfg: TrainConfig) -> optax.GradientTransformation:
     )
 
 
+class EmaState(NamedTuple):
+    ema: Any  # params-like pytree
+
+
+def ema_of_params(decay: float) -> optax.GradientTransformation:
+    """Track an exponential moving average of the *post-update* params.
+
+    Chain this LAST after the real optimizer: its `update` sees the final
+    deltas, reconstructs new_params = params + updates, and folds them into
+    the average (updates pass through untouched). The EMA tree mirrors the
+    param tree structure, so it inherits param shardings in
+    `state_shardings`, and is checkpointed with the rest of the optimizer
+    state. Initialised at the initial params (no bias correction — the
+    standard LLM-eval choice: after ~3/(1-decay) steps the init's weight
+    is negligible).
+
+    The accumulator is ALWAYS float32: with bf16 master params a typical
+    decay (0.99+) makes the per-step contribution (1-decay)*p smaller than
+    bf16 resolution, so a same-dtype average would silently stay frozen at
+    its init.
+    """
+
+    def init(params):
+        return EmaState(ema=jax.tree.map(
+            lambda p: jnp.asarray(p, jnp.float32), params))
+
+    def update(updates, state, params):
+        if params is None:
+            raise ValueError("ema_of_params requires params")
+        new_params = optax.apply_updates(params, updates)
+        ema = jax.tree.map(
+            lambda e, p: decay * e + (1.0 - decay) * p.astype(e.dtype),
+            state.ema, new_params)
+        return updates, EmaState(ema=ema)
+
+    return optax.GradientTransformation(init, update)
+
+
+def ema_params(opt_state):
+    """Pull the EMA param tree out of an optimizer state (wherever the
+    EmaState sits in the chain). Returns None when EMA is disabled."""
+    flat = jax.tree.flatten(
+        opt_state, is_leaf=lambda x: isinstance(x, EmaState))[0]
+    for leaf in flat:
+        if isinstance(leaf, EmaState):
+            return leaf.ema
+    return None
+
+
 def make_optimizer(cfg: TrainConfig,
                    param_labels=None) -> optax.GradientTransformation:
     """param_labels: optional pytree (matching params) of "trainable" /
     "frozen" strings — frozen params get `set_to_zero` and allocate no
-    moments (the LoRA fine-tuning path; see models/lora.py)."""
+    moments (the LoRA fine-tuning path; see models/lora.py).
+
+    cfg.ema_decay > 0 appends `ema_of_params` to the chain (for LoRA this
+    averages the full tree; frozen leaves converge to their fixed values
+    after the warm-in window)."""
     opt = fused_adamw(cfg)
-    if param_labels is None:
-        return opt
-    return optax.multi_transform(
-        {"trainable": opt, "frozen": optax.set_to_zero()}, param_labels)
+    if param_labels is not None:
+        opt = optax.multi_transform(
+            {"trainable": opt, "frozen": optax.set_to_zero()}, param_labels)
+    if cfg.ema_decay > 0.0:
+        opt = optax.chain(opt, ema_of_params(cfg.ema_decay))
+    return opt
 
 
 def optimizer_for_module(train_cfg: TrainConfig, model_cfg, loss_fn_module):

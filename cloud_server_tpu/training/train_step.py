@@ -37,15 +37,22 @@ class TrainState(NamedTuple):
 
 def state_shardings(model_cfg: ModelConfig, mesh: Mesh,
                     rules=DEFAULT_RULES,
-                    loss_fn_module=transformer) -> TrainState:
-    """Build the TrainState sharding pytree by abstract-evaluating init."""
+                    loss_fn_module=transformer,
+                    train_cfg: TrainConfig | None = None) -> TrainState:
+    """Build the TrainState sharding pytree by abstract-evaluating init.
+
+    `train_cfg` matters because optimizer-state STRUCTURE depends on it
+    (ema_decay adds an EmaState to the chain) — callers building shardings
+    for a real state must pass the same config that built its optimizer.
+    """
     logical = loss_fn_module.param_logical_axes(model_cfg)
     param_sh = logical_to_sharding(logical, mesh, rules)
 
     # Optimizer state mirrors params; derive its sharding by matching
     # structure: any leaf of opt_state with the same shape as a param gets
     # the param's sharding, scalars are replicated.
-    opt = optimizer_for_module(TrainConfig(), model_cfg, loss_fn_module)
+    opt = optimizer_for_module(train_cfg or TrainConfig(), model_cfg,
+                               loss_fn_module)
     params_shape = jax.eval_shape(
         partial(loss_fn_module.init_params, model_cfg), jax.random.key(0))
     opt_shape = jax.eval_shape(opt.init, params_shape)
@@ -53,12 +60,18 @@ def state_shardings(model_cfg: ModelConfig, mesh: Mesh,
     flat_params, _ = jax.tree.flatten(params_shape)
     flat_param_sh, _ = jax.tree.flatten(param_sh)
     shape_to_sh = {}
+    shape_only = {}
     for p, s in zip(flat_params, flat_param_sh):
         shape_to_sh.setdefault((p.shape, p.dtype), s)
+        shape_only.setdefault(p.shape, s)
     replicated = NamedSharding(mesh, P())
 
     def opt_leaf_sharding(leaf):
-        return shape_to_sh.get((leaf.shape, leaf.dtype), replicated)
+        # Shape-only fallback: the EMA tree is always float32, so with
+        # bf16 master params its leaves match param shapes but not dtypes
+        # — they must still shard like the params, not replicate.
+        return shape_to_sh.get((leaf.shape, leaf.dtype),
+                               shape_only.get(leaf.shape, replicated))
 
     opt_sh = jax.tree.map(opt_leaf_sharding, opt_shape)
     return TrainState(step=replicated, params=param_sh, opt_state=opt_sh)
@@ -70,7 +83,8 @@ def init_train_state(model_cfg: ModelConfig, train_cfg: TrainConfig,
     """Initialise params + optimizer state *sharded* — each device only
     materialises its own shard (init runs under jit with out_shardings)."""
     shardings = state_shardings(model_cfg, mesh, rules,
-                                loss_fn_module=loss_fn_module)
+                                loss_fn_module=loss_fn_module,
+                                train_cfg=train_cfg)
     opt = optimizer_for_module(train_cfg, model_cfg, loss_fn_module)
 
     def init_fn(rng):
@@ -99,7 +113,8 @@ def make_train_step(model_cfg: ModelConfig, train_cfg: TrainConfig,
             kwargs["router_z_coef"] = train_cfg.moe_router_z_coef
         loss_fn = partial(loss_fn_module.next_token_loss, **kwargs)
     opt = optimizer_for_module(train_cfg, model_cfg, loss_fn_module)
-    shardings = state_shardings(model_cfg, mesh, rules, loss_fn_module)
+    shardings = state_shardings(model_cfg, mesh, rules, loss_fn_module,
+                                train_cfg=train_cfg)
     # (B, S): batch over (dp, fsdp), sequence over sp — with sp > 1 every
     # activation downstream of the embedding (norms, MLP, fused CE) computes
     # S/sp per device; only ring attention sees the full sequence, via its

@@ -1,0 +1,156 @@
+"""Parameter EMA: tracking math, sharding/checkpoint round-trip, loop
+eval integration, CLI serving."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cloud_server_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from cloud_server_tpu.models import transformer
+from cloud_server_tpu.parallel.mesh import make_mesh
+from cloud_server_tpu.training import init_train_state, make_train_step
+from cloud_server_tpu.training.optim import ema_params
+
+TINY = ModelConfig(
+    vocab_size=64, embed_dim=32, num_layers=2, num_heads=4, num_kv_heads=4,
+    head_dim=8, mlp_dim=64, max_seq_len=32, dtype="float32",
+    param_dtype="float32", remat="none")
+
+
+def _tokens(b=8, s=32):
+    return jax.random.randint(jax.random.key(1), (b, s), 0, 64)
+
+
+def test_ema_tracks_post_update_params(devices8):
+    decay = 0.5
+    tcfg = TrainConfig(learning_rate=1e-2, warmup_steps=1, total_steps=10,
+                       ema_decay=decay)
+    mesh = make_mesh(MeshConfig(fsdp=8))
+    state = init_train_state(TINY, tcfg, mesh, jax.random.key(0))
+    step, bsh = make_train_step(TINY, tcfg, mesh)
+    data = {"tokens": jax.device_put(np.asarray(_tokens()), bsh)}
+
+    p0 = jax.device_get(state.params)
+    want = jax.tree.map(np.asarray, p0)  # ema init = initial params
+    for _ in range(3):
+        state, _ = step(state, data)
+        p = jax.device_get(state.params)
+        want = jax.tree.map(
+            lambda e, q: decay * e + (1 - decay) * np.asarray(q), want, p)
+    got = jax.device_get(ema_params(state.opt_state))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+        got, want)
+    # EMA must differ from both the initial and the current params
+    leaf = lambda t: jax.tree.leaves(t)[0]
+    assert not np.allclose(leaf(got), leaf(jax.device_get(state.params)))
+    assert not np.allclose(leaf(got), leaf(p0))
+
+
+def test_ema_f32_accumulator_tracks_bf16_params(devices8):
+    """With bf16 master params and a high decay, a same-dtype accumulator
+    would freeze ((1-decay)*p underflows bf16 resolution); the f32
+    accumulator must still move and stay sharded like the params."""
+    cfg = ModelConfig(**{**TINY.__dict__, "param_dtype": "bfloat16"})
+    tcfg = TrainConfig(learning_rate=3e-2, warmup_steps=1, total_steps=20,
+                       ema_decay=0.99)
+    mesh = make_mesh(MeshConfig(fsdp=8))
+    state = init_train_state(cfg, tcfg, mesh, jax.random.key(0))
+    step, bsh = make_train_step(cfg, tcfg, mesh)
+    data = {"tokens": jax.device_put(np.asarray(_tokens()), bsh)}
+    ema0 = jax.device_get(ema_params(state.opt_state))
+    for _ in range(5):
+        state, _ = step(state, data)
+    ema = ema_params(state.opt_state)
+    leaf = jax.tree.leaves(ema)[0]
+    assert leaf.dtype == jnp.float32
+    # embed is fsdp-sharded in params; its f32 EMA must be too
+    emb_sh = ema["embed"]["tokens"].sharding
+    assert emb_sh.spec == state.params["embed"]["tokens"].sharding.spec
+    moved = np.abs(np.asarray(jax.tree.leaves(ema)[0], np.float32)
+                   - np.asarray(jax.tree.leaves(ema0)[0], np.float32)).max()
+    assert moved > 0.0, "f32 EMA accumulator did not move"
+
+
+def test_ema_disabled_returns_none(devices8):
+    tcfg = TrainConfig(warmup_steps=1, total_steps=5)
+    mesh = make_mesh(MeshConfig())
+    state = init_train_state(TINY, tcfg, mesh, jax.random.key(0))
+    assert ema_params(state.opt_state) is None
+
+
+def test_ema_checkpoint_roundtrip(tmp_path, devices8):
+    from cloud_server_tpu.training.checkpoint import (
+        Checkpointer, abstract_train_state)
+
+    tcfg = TrainConfig(learning_rate=1e-2, warmup_steps=1, total_steps=10,
+                       ema_decay=0.9)
+    mesh = make_mesh(MeshConfig(fsdp=4, tp=2))
+    state = init_train_state(TINY, tcfg, mesh, jax.random.key(0))
+    step, bsh = make_train_step(TINY, tcfg, mesh)
+    data = {"tokens": jax.device_put(np.asarray(_tokens()), bsh)}
+    state, _ = step(state, data)
+    state, _ = step(state, data)
+
+    with Checkpointer(tmp_path / "ckpt") as ckpt:
+        assert ckpt.save(state)
+        ckpt.wait()
+        target = abstract_train_state(TINY, tcfg, mesh)
+        restored = ckpt.restore(target)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        jax.device_get(ema_params(restored.opt_state)),
+        jax.device_get(ema_params(state.opt_state)))
+
+
+def test_ema_with_lora(devices8):
+    """EMA composes with the LoRA multi_transform optimizer."""
+    from cloud_server_tpu.models.lora import LoRAConfig, make_lora_module
+
+    module = make_lora_module(LoRAConfig(rank=2))
+    tcfg = TrainConfig(learning_rate=1e-2, warmup_steps=1, total_steps=10,
+                       ema_decay=0.5)
+    mesh = make_mesh(MeshConfig())
+    state = init_train_state(TINY, tcfg, mesh, jax.random.key(0),
+                             loss_fn_module=module)
+    step, bsh = make_train_step(TINY, tcfg, mesh, loss_fn_module=module)
+    data = {"tokens": jax.device_put(np.asarray(_tokens()), bsh)}
+    state, _ = step(state, data)
+    avg = ema_params(state.opt_state)
+    assert avg is not None
+    # frozen base stays put, so its EMA equals the base weights exactly
+    np.testing.assert_array_equal(
+        np.asarray(avg["base"]["embed"]["tokens"]),
+        np.asarray(state.params["base"]["embed"]["tokens"]))
+
+
+def test_generate_cli_serves_ema(tmp_path, capsys, devices8):
+    """Train with ema_decay, then serve the averaged weights via --ema."""
+    from cloud_server_tpu.data.tokenizer import main as tokenize_main
+    from cloud_server_tpu.generate import main as generate_main
+    from cloud_server_tpu.train import main as train_main
+
+    (tmp_path / "corpus.txt").write_text("abcdefgh\n" * 400)
+    cfg = {"model": {"vocab_size": 259, "embed_dim": 32, "num_layers": 2,
+                     "num_heads": 4, "num_kv_heads": 2, "head_dim": 8,
+                     "mlp_dim": 64, "max_seq_len": 64, "dtype": "float32",
+                     "param_dtype": "float32", "remat": "none"},
+           "train": {"total_steps": 30, "batch_size": 8, "seq_len": 16,
+                     "warmup_steps": 2, "learning_rate": 0.01,
+                     "ema_decay": 0.8},
+           "loop": {"log_interval": 30}}
+    (tmp_path / "cfg.json").write_text(json.dumps(cfg))
+    tokenize_main([str(tmp_path / "corpus.txt"), str(tmp_path / "t.bin")])
+    train_main(["--config", str(tmp_path / "cfg.json"),
+                "--data", str(tmp_path / "t.bin"),
+                "--checkpoint-dir", str(tmp_path / "ckpt")])
+    generate_main(["--config", str(tmp_path / "cfg.json"),
+                   "--checkpoint-dir", str(tmp_path / "ckpt"),
+                   "--prompt", "abcd", "--max-new", "8",
+                   "--temperature", "0", "--ema"])
+    out = capsys.readouterr().out
+    assert "'abcd'" in out
+    assert "efgh" in out.rsplit("'abcd'", 1)[1]
