@@ -187,19 +187,36 @@ def moe_mlp_block(x, lp, cfg: ModelConfig):
     return x + out, aux
 
 
-def _moe_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn):
-    x = transformer._attention_block(x, lp, cfg, cos, sin, attn_fn)
+def _moe_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn, positions=None):
+    x = transformer._attention_block(x, lp, cfg, cos, sin, attn_fn, positions)
     return moe_mlp_block(x, lp, cfg)
 
 
-def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
-    """(B, S) -> (final-normed hidden (B, S, D), aux dict of router stats)."""
+def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
+                   segment_ids: jnp.ndarray | None = None):
+    """(B, S) -> (final-normed hidden (B, S, D), aux dict of router stats).
+
+    segment_ids: optional packed-sequence ids — same block-diagonal
+    attention + per-document RoPE semantics as the dense family
+    (transformer.forward_hidden)."""
     cos, sin = rope_table(cfg, tokens.shape[1])
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
     x = transformer.constrain(x, ("batch", "sequence", None))
-    attn_fn = transformer._get_attention_fn(cfg)
+    positions = None
+    if segment_ids is not None:
+        if cfg.attention_impl != "xla":
+            raise ValueError(
+                f"packed segment_ids support requires attention_impl='xla' "
+                f"(got {cfg.attention_impl!r})")
+        from cloud_server_tpu.ops.segments import positions_from_segments
+        from cloud_server_tpu.ops import causal_attention
+        positions = positions_from_segments(segment_ids)
+        attn_fn = partial(causal_attention, segment_ids=segment_ids)
+    else:
+        attn_fn = transformer._get_attention_fn(cfg)
 
-    block = partial(_moe_block, cfg=cfg, cos=cos, sin=sin, attn_fn=attn_fn)
+    block = partial(_moe_block, cfg=cfg, cos=cos, sin=sin, attn_fn=attn_fn,
+                    positions=positions)
     block = transformer.apply_remat(block, cfg)
 
     def scan_body(carry, lp):
@@ -217,21 +234,29 @@ def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
     return x, aux
 
 
-def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
+def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
+            segment_ids: jnp.ndarray | None = None):
     """(B, S) -> (logits (B, S, V) f32, aux dict of scalar router stats)."""
-    x, aux = forward_hidden(params, tokens, cfg)
+    x, aux = forward_hidden(params, tokens, cfg, segment_ids)
     return transformer.unembed(x, params, cfg), aux
 
 
 def next_token_loss(params: Params, batch: dict, cfg: ModelConfig,
                     z_loss_coef: float = 0.0, aux_loss_coef: float = 0.01,
                     router_z_coef: float = 0.0):
+    seg = batch.get("segment_ids")
+    if seg is not None:
+        from cloud_server_tpu.ops.segments import segment_target_mask
+        tmask = segment_target_mask(seg)
+        if batch.get("mask") is not None:
+            tmask = tmask * batch["mask"].astype(tmask.dtype)
+        batch = {**batch, "mask": tmask}
     if cfg.vocab_chunk > 0:
-        x, aux = forward_hidden(params, batch["tokens"], cfg)
+        x, aux = forward_hidden(params, batch["tokens"], cfg, segment_ids=seg)
         loss, metrics = transformer.fused_cross_entropy(
             x, params, batch, cfg, z_loss_coef)
     else:
-        logits, aux = forward(params, batch["tokens"], cfg)
+        logits, aux = forward(params, batch["tokens"], cfg, segment_ids=seg)
         loss, metrics = transformer.masked_cross_entropy(
             logits, batch, z_loss_coef)
     metrics.update(load_balance=aux["load_balance"],

@@ -133,8 +133,9 @@ def attention_out(x, o, lp, cfg: ModelConfig):
     return x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
 
 
-def _attention_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn):
-    q, k, v = attention_qkv(x, lp, cfg, cos, sin)
+def _attention_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn,
+                     positions=None):
+    q, k, v = attention_qkv(x, lp, cfg, cos, sin, positions)
     o = attn_fn(q, k, v)
     return attention_out(x, o, lp, cfg)
 
@@ -160,8 +161,9 @@ def unembed(x, params: Params, cfg: ModelConfig) -> jnp.ndarray:
     return apply_logits_softcap(logits, cfg)
 
 
-def _block(x, layer_params, cfg: ModelConfig, cos, sin, attn_fn):
-    x = _attention_block(x, layer_params, cfg, cos, sin, attn_fn)
+def _block(x, layer_params, cfg: ModelConfig, cos, sin, attn_fn,
+           positions=None):
+    x = _attention_block(x, layer_params, cfg, cos, sin, attn_fn, positions)
     x = mlp_block(x, layer_params, cfg)
     return x
 
@@ -231,17 +233,36 @@ def _get_attention_fn(cfg: ModelConfig):
 
 
 def forward_hidden(params: Params, tokens: jnp.ndarray,
-                   cfg: ModelConfig) -> jnp.ndarray:
-    """(B, S) int32 -> final-normed hidden states (B, S, D) in cfg.dtype."""
+                   cfg: ModelConfig,
+                   segment_ids: jnp.ndarray | None = None) -> jnp.ndarray:
+    """(B, S) int32 -> final-normed hidden states (B, S, D) in cfg.dtype.
+
+    segment_ids: optional (B, S) packed-sequence ids (data/packing.py) —
+    attention becomes block-diagonal causal and RoPE positions restart per
+    document, so each packed document sees exactly the math it would see
+    alone.
+    """
     cos, sin = rope_table(cfg, tokens.shape[1])
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
     # Anchor the residual stream to (batch, sequence, -) so that with
     # sp > 1 every per-position op (norms, MLP, fused CE) computes S/sp per
     # device; only ring attention's shard_map sees the full sequence.
     x = constrain(x, ("batch", "sequence", None))
-    attn_fn = _get_attention_fn(cfg)
+    positions = None
+    if segment_ids is not None:
+        if cfg.attention_impl != "xla":
+            raise ValueError(
+                f"packed segment_ids support requires attention_impl='xla' "
+                f"(got {cfg.attention_impl!r}); the flash/ring/ulysses "
+                "paths do not take a segment mask yet")
+        from cloud_server_tpu.ops.segments import positions_from_segments
+        positions = positions_from_segments(segment_ids)
+        attn_fn = partial(causal_attention, segment_ids=segment_ids)
+    else:
+        attn_fn = _get_attention_fn(cfg)
 
-    block = partial(_block, cfg=cfg, cos=cos, sin=sin, attn_fn=attn_fn)
+    block = partial(_block, cfg=cfg, cos=cos, sin=sin, attn_fn=attn_fn,
+                    positions=positions)
     block = apply_remat(block, cfg)
 
     def scan_body(carry, layer_params):
@@ -252,9 +273,11 @@ def forward_hidden(params: Params, tokens: jnp.ndarray,
     return rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
 
 
-def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
+            segment_ids: jnp.ndarray | None = None) -> jnp.ndarray:
     """Full-sequence forward pass: (B, S) int32 -> (B, S, V) float32 logits."""
-    return unembed(forward_hidden(params, tokens, cfg), params, cfg)
+    return unembed(forward_hidden(params, tokens, cfg, segment_ids),
+                   params, cfg)
 
 
 # ---------------------------------------------------------------------------
@@ -379,15 +402,26 @@ def fused_cross_entropy(x, params: Params, batch: dict, cfg: ModelConfig,
 
 def next_token_loss(params: Params, batch: dict, cfg: ModelConfig,
                     z_loss_coef: float = 0.0):
-    """Causal LM loss. batch: {"tokens": (B, S) int32, optional "mask": (B, S)}.
+    """Causal LM loss. batch: {"tokens": (B, S) int32, optional
+    "mask": (B, S), optional "segment_ids": (B, S) for packed rows}.
 
     Predicts tokens[:, 1:] from tokens[:, :-1]. Forward runs on the full S
     (not S-1) so the sequence stays divisible for sp-sharded attention; the
     last position is dropped inside the loss. With cfg.vocab_chunk > 0 the
-    logits never materialise (see `fused_cross_entropy`).
+    logits never materialise (see `fused_cross_entropy`). With
+    segment_ids, attention/positions follow the packing (see
+    `forward_hidden`) and targets crossing a document boundary (or in
+    padding) are masked out of the loss.
     """
+    seg = batch.get("segment_ids")
+    if seg is not None:
+        from cloud_server_tpu.ops.segments import segment_target_mask
+        tmask = segment_target_mask(seg)
+        if batch.get("mask") is not None:
+            tmask = tmask * batch["mask"].astype(tmask.dtype)
+        batch = {**batch, "mask": tmask}
     if cfg.vocab_chunk > 0:
-        x = forward_hidden(params, batch["tokens"], cfg)
+        x = forward_hidden(params, batch["tokens"], cfg, segment_ids=seg)
         return fused_cross_entropy(x, params, batch, cfg, z_loss_coef)
-    logits = forward(params, batch["tokens"], cfg)
+    logits = forward(params, batch["tokens"], cfg, segment_ids=seg)
     return masked_cross_entropy(logits, batch, z_loss_coef)

@@ -27,6 +27,7 @@ def causal_attention(
     kv_segment_start: int = 0,
     q_positions: jnp.ndarray | None = None,
     kv_length: jnp.ndarray | None = None,
+    segment_ids: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Causal grouped-query attention, dense XLA implementation.
 
@@ -41,6 +42,11 @@ def causal_attention(
         arange(Sq) + kv_segment_start... i.e. aligned with the kv chunk.
       kv_length: optional (B,) number of valid kv entries (decode-time
         cache masking). Defaults to all valid.
+      segment_ids: optional (B, S) packed-sequence ids (Sq == Skv case):
+        attention is additionally masked to same-segment pairs, giving the
+        block-diagonal causal structure packed training needs. The causal
+        mask itself stays on global row positions (within a segment the
+        global and local orders agree; across segments this mask wins).
 
     Returns:
       (B, Sq, H, Dh) in q.dtype.
@@ -66,6 +72,9 @@ def causal_attention(
     if kv_length is not None:
         valid = kv_pos < kv_length[:, None]  # (B, Skv)
         causal = jnp.logical_and(causal, valid[:, None, :])
+    if segment_ids is not None:
+        same = segment_ids[:, :, None] == segment_ids[:, None, :]  # (B,Sq,Skv)
+        causal = jnp.logical_and(causal, same)
     scores = jnp.where(causal[:, None, None, :, :], scores, NEG_INF)
 
     probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))

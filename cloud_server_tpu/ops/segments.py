@@ -1,0 +1,35 @@
+"""Segment-id helpers for packed sequences (see data/packing.py).
+
+Convention: segment_ids (B, S) int32, 0 = padding, documents numbered
+1, 2, ... left-to-right within each row.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def positions_from_segments(segment_ids: jnp.ndarray) -> jnp.ndarray:
+    """(B, S) segment ids -> (B, S) int32 RoPE positions restarting at 0
+    at every segment boundary (padding positions are counted within their
+    run but are masked everywhere downstream, so their values are moot)."""
+    b, s = segment_ids.shape
+    idx = jnp.arange(s, dtype=jnp.int32)[None, :]
+    change = jnp.concatenate(
+        [jnp.ones((b, 1), bool),
+         segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1)
+    start = jnp.where(change, idx, 0)
+    running_start = lax.cummax(start, axis=1)
+    return idx - running_start
+
+
+def segment_target_mask(segment_ids: jnp.ndarray) -> jnp.ndarray:
+    """(B, S) segment ids -> (B, S) f32 token mask for the next-token loss:
+    token j counts as a target iff it continues its predecessor's segment
+    (same id, not padding). Position 0 is never a target (both CE paths
+    drop it)."""
+    prev_same = jnp.concatenate(
+        [jnp.zeros((segment_ids.shape[0], 1), bool),
+         segment_ids[:, 1:] == segment_ids[:, :-1]], axis=1)
+    return (prev_same & (segment_ids > 0)).astype(jnp.float32)

@@ -120,15 +120,26 @@ class Checkpointer:
             if step is None:
                 raise FileNotFoundError(
                     f"no checkpoint found under {self._mngr.directory}")
+        # Layout is decided by what save() wrote, visible on disk: an
+        # "ema" item dir means named-items layout. (Detecting by catching
+        # ValueError would also swallow real tree-structure mismatches.)
+        has_ema_item = os.path.isdir(
+            os.path.join(os.fspath(self._mngr.directory), str(step), "ema"))
         try:
-            # single-item layout (no ema item saved)
+            if has_ema_item:
+                return self._mngr.restore(
+                    step, args=ocp.args.Composite(
+                        default=ocp.args.StandardRestore(target)))["default"]
             return self._mngr.restore(
                 step, args=ocp.args.StandardRestore(target))
-        except ValueError:
-            # named-items layout (state under "default", ema alongside)
-            return self._mngr.restore(
-                step, args=ocp.args.Composite(
-                    default=ocp.args.StandardRestore(target)))["default"]
+        except ValueError as e:
+            raise ValueError(
+                f"restore of step {step} failed with a structure mismatch. "
+                "If TrainConfig.ema_decay was toggled since this checkpoint "
+                "was written, the optimizer-state tree no longer matches — "
+                "resume with the original ema_decay setting (or restore "
+                "params-only via restore_params and re-init the optimizer)."
+            ) from e
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -153,6 +164,32 @@ class Checkpointer:
         self.close()
 
 
+def _abstract_sharded_params(model_cfg: ModelConfig, mesh,
+                             rules=DEFAULT_RULES, loss_fn_module=transformer,
+                             dtype=None):
+    """Sharded ShapeDtypeStruct tree for a module's params — the restore
+    `target` both params-style restores build. `dtype` overrides every
+    leaf dtype (the EMA accumulator is float32 regardless of param_dtype).
+    """
+    from functools import partial
+
+    logical = loss_fn_module.param_logical_axes(model_cfg)
+    shardings = logical_to_sharding(logical, mesh, rules)
+    shapes = jax.eval_shape(partial(loss_fn_module.init_params, model_cfg),
+                            jax.random.key(0))
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, dtype or s.dtype,
+                                           sharding=sh),
+        shapes, shardings)
+
+
+def _latest_step(directory: str) -> int:
+    steps = ocp.utils.checkpoint_steps(directory)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint found under {directory}")
+    return max(steps)
+
+
 def restore_params(checkpoint_dir: str | os.PathLike, model_cfg: ModelConfig,
                    mesh, *, step: int | None = None, rules=DEFAULT_RULES,
                    loss_fn_module=transformer):
@@ -162,22 +199,11 @@ def restore_params(checkpoint_dir: str | os.PathLike, model_cfg: ModelConfig,
     of a saved TrainState (~1/3 of the checkpoint bytes; Adam's two moment
     trees are never touched), sharded straight onto `mesh`.
     """
-    from functools import partial
-
     directory = os.path.abspath(os.fspath(checkpoint_dir))
     if step is None:
-        steps = ocp.utils.checkpoint_steps(directory)
-        if not steps:
-            raise FileNotFoundError(f"no checkpoint found under {directory}")
-        step = max(steps)
-
-    logical = loss_fn_module.param_logical_axes(model_cfg)
-    shardings = logical_to_sharding(logical, mesh, rules)
-    shapes = jax.eval_shape(partial(loss_fn_module.init_params, model_cfg),
-                            jax.random.key(0))
-    target = {"params": jax.tree.map(
-        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-        shapes, shardings)}
+        step = _latest_step(directory)
+    target = {"params": _abstract_sharded_params(model_cfg, mesh, rules,
+                                                 loss_fn_module)}
     restore_args = ocp.checkpoint_utils.construct_restore_args(target)
     with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
         out = ckptr.restore(
@@ -197,14 +223,9 @@ def restore_ema_params(checkpoint_dir: str | os.PathLike,
     params-sized read; no optimizer-moment or raw-param IO. The tree is
     float32 (the EMA accumulator dtype) and drop-in wherever params go
     (forwards cast to cfg.dtype at use)."""
-    from functools import partial
-
     directory = os.path.abspath(os.fspath(checkpoint_dir))
     if step is None:
-        steps = ocp.utils.checkpoint_steps(directory)
-        if not steps:
-            raise FileNotFoundError(f"no checkpoint found under {directory}")
-        step = max(steps)
+        step = _latest_step(directory)
     item_dir = os.path.join(directory, str(step), "ema")
     if not os.path.isdir(item_dir):
         raise FileNotFoundError(
@@ -212,13 +233,8 @@ def restore_ema_params(checkpoint_dir: str | os.PathLike,
             "trained with TrainConfig.ema_decay > 0 (and saved by this "
             "version)?")
 
-    logical = loss_fn_module.param_logical_axes(model_cfg)
-    shardings = logical_to_sharding(logical, mesh, rules)
-    shapes = jax.eval_shape(partial(loss_fn_module.init_params, model_cfg),
-                            jax.random.key(0))
-    target = jax.tree.map(
-        lambda s, sh: jax.ShapeDtypeStruct(s.shape, jnp.float32, sharding=sh),
-        shapes, shardings)
+    target = _abstract_sharded_params(model_cfg, mesh, rules, loss_fn_module,
+                                      dtype=jnp.float32)
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
         return ckptr.restore(item_dir, args=ocp.args.StandardRestore(target))
 

@@ -106,6 +106,29 @@ def test_ema_checkpoint_roundtrip(tmp_path, devices8):
         jax.device_get(ema_params(state.opt_state)))
 
 
+def test_ema_toggle_resume_fails_clearly(tmp_path, devices8):
+    """Resuming a pre-EMA checkpoint with ema_decay newly enabled must
+    fail with a message naming the cause, not an opaque orbax error."""
+    import pytest
+
+    from cloud_server_tpu.training.checkpoint import (
+        Checkpointer, abstract_train_state)
+
+    tcfg_off = TrainConfig(warmup_steps=1, total_steps=10)
+    tcfg_on = TrainConfig(warmup_steps=1, total_steps=10, ema_decay=0.9)
+    mesh = make_mesh(MeshConfig())
+    state = init_train_state(TINY, tcfg_off, mesh, jax.random.key(0))
+    step, bsh = make_train_step(TINY, tcfg_off, mesh)
+    state, _ = step(state, {"tokens": jax.device_put(
+        np.asarray(_tokens()), bsh)})
+    with Checkpointer(tmp_path / "ckpt") as ckpt:
+        assert ckpt.save(state)
+        ckpt.wait()
+        target = abstract_train_state(TINY, tcfg_on, mesh)
+        with pytest.raises(ValueError, match="ema_decay"):
+            ckpt.restore(target)
+
+
 def test_ema_with_lora(devices8):
     """EMA composes with the LoRA multi_transform optimizer."""
     from cloud_server_tpu.models.lora import LoRAConfig, make_lora_module

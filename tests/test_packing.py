@@ -1,0 +1,151 @@
+"""Sequence packing: packer layout, segment helpers, and the key
+equivalence — a packed row reproduces each document's standalone math."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cloud_server_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from cloud_server_tpu.data.packing import (
+    PackedTokenDataset, pack_documents, packing_efficiency)
+from cloud_server_tpu.models import transformer
+from cloud_server_tpu.ops.segments import (
+    positions_from_segments, segment_target_mask)
+
+TINY = ModelConfig(
+    vocab_size=64, embed_dim=32, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=8, mlp_dim=64, max_seq_len=32, dtype="float32",
+    param_dtype="float32", remat="none")
+
+
+def test_pack_documents_layout():
+    toks, segs = pack_documents([[1, 2, 3], [4, 5], [6, 7, 8, 9]], 6)
+    np.testing.assert_array_equal(toks, [[1, 2, 3, 4, 5, 0],
+                                         [6, 7, 8, 9, 0, 0]])
+    np.testing.assert_array_equal(segs, [[1, 1, 1, 2, 2, 0],
+                                         [1, 1, 1, 1, 0, 0]])
+    assert packing_efficiency(segs) == pytest.approx(9 / 12)
+
+
+def test_pack_documents_splits_long_docs():
+    toks, segs = pack_documents([list(range(1, 11))], 4)
+    np.testing.assert_array_equal(
+        toks, [[1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 0, 0]])
+    # each piece is its own segment
+    np.testing.assert_array_equal(
+        segs, [[1, 1, 1, 1], [1, 1, 1, 1], [1, 1, 0, 0]])
+
+
+def test_positions_restart_per_segment():
+    segs = jnp.asarray([[1, 1, 1, 2, 2, 0], [1, 1, 1, 1, 0, 0]])
+    pos = positions_from_segments(segs)
+    np.testing.assert_array_equal(pos, [[0, 1, 2, 0, 1, 0],
+                                        [0, 1, 2, 3, 0, 1]])
+
+
+def test_segment_target_mask():
+    segs = jnp.asarray([[1, 1, 2, 2, 0, 0]])
+    np.testing.assert_array_equal(segment_target_mask(segs),
+                                  [[0, 1, 0, 1, 0, 0]])
+
+
+def test_packed_forward_matches_standalone():
+    """Logits inside a packed row must equal each document's standalone
+    logits — validates the segment mask AND the per-segment positions."""
+    params = transformer.init_params(TINY, jax.random.key(0))
+    d1 = [5, 9, 3, 17, 6]
+    d2 = [8, 4, 1, 2, 7, 11, 13]
+    toks, segs = pack_documents([d1, d2], 16)
+    packed = transformer.forward(params, jnp.asarray(toks),
+                                 TINY, jnp.asarray(segs))
+    alone1 = transformer.forward(params, jnp.asarray([d1]), TINY)
+    alone2 = transformer.forward(params, jnp.asarray([d2]), TINY)
+    np.testing.assert_allclose(np.asarray(packed[0, :5]),
+                               np.asarray(alone1[0]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(packed[0, 5:12]),
+                               np.asarray(alone2[0]), atol=1e-4)
+
+
+@pytest.mark.parametrize("vocab_chunk", [0, 32])
+def test_packed_loss_matches_standalone(vocab_chunk):
+    """Packed loss == token-weighted mean of standalone per-doc losses
+    (cross-boundary and padding targets masked) on both CE paths."""
+    import dataclasses
+    cfg = dataclasses.replace(TINY, vocab_chunk=vocab_chunk)
+    params = transformer.init_params(cfg, jax.random.key(0))
+    d1 = [5, 9, 3, 17, 6, 2]
+    d2 = [8, 4, 1, 2, 7, 11, 13, 9]
+    toks, segs = pack_documents([d1, d2], 16)
+    batch = {"tokens": jnp.asarray(toks), "segment_ids": jnp.asarray(segs)}
+    packed_loss, metrics = transformer.next_token_loss(params, batch, cfg)
+
+    def alone_nll(doc):
+        loss, _ = transformer.next_token_loss(
+            params, {"tokens": jnp.asarray([doc])}, cfg)
+        return float(loss) * (len(doc) - 1)
+
+    want = (alone_nll(d1) + alone_nll(d2)) / (len(d1) + len(d2) - 2)
+    assert float(packed_loss) == pytest.approx(want, rel=1e-5)
+
+
+def test_packed_train_step_runs_sharded(devices8):
+    """segment_ids flow through the sharded train step and the loss
+    decreases."""
+    from cloud_server_tpu.parallel.mesh import make_mesh
+    from cloud_server_tpu.training import init_train_state, make_train_step
+
+    docs = [list(np.random.RandomState(i).randint(1, 64, 5 + i % 7))
+            for i in range(64)]
+    ds = PackedTokenDataset(docs, 32)
+    rows = min(8, len(ds))
+    batch_np = {k: np.stack([ds[i][k] for i in range(rows)])
+                for k in ("tokens", "segment_ids")}
+    tcfg = TrainConfig(learning_rate=1e-2, warmup_steps=1, total_steps=10)
+    mesh = make_mesh(MeshConfig(fsdp=4, tp=2))
+    state = init_train_state(TINY, tcfg, mesh, jax.random.key(0))
+    step, bsh = make_train_step(TINY, tcfg, mesh)
+    data = {k: jax.device_put(v, bsh) for k, v in batch_np.items()}
+    losses = []
+    for _ in range(8):
+        state, m = step(state, data)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.3
+
+
+def test_packed_moe_loss_matches_standalone():
+    """The MoE family honours segment_ids the same way the dense one does
+    (capacity must be generous so routing is identical packed vs alone)."""
+    import dataclasses
+
+    from cloud_server_tpu.models import moe
+
+    cfg = dataclasses.replace(TINY, num_experts=4,
+                              expert_capacity_factor=8.0)
+    params = moe.init_params(cfg, jax.random.key(0))
+    d1 = [5, 9, 3, 17, 6, 2]
+    d2 = [8, 4, 1, 2, 7, 11, 13, 9]
+    toks, segs = pack_documents([d1, d2], 16)
+    batch = {"tokens": jnp.asarray(toks), "segment_ids": jnp.asarray(segs)}
+    # aux losses off: router stats aggregate over padding differently than
+    # in the standalone runs, which is expected — CE must still match.
+    packed_loss, _ = moe.next_token_loss(params, batch, cfg,
+                                         aux_loss_coef=0.0)
+
+    def alone_nll(doc):
+        loss, _ = moe.next_token_loss(
+            params, {"tokens": jnp.asarray([doc])}, cfg, aux_loss_coef=0.0)
+        return float(loss) * (len(doc) - 1)
+
+    want = (alone_nll(d1) + alone_nll(d2)) / (len(d1) + len(d2) - 2)
+    assert float(packed_loss) == pytest.approx(want, rel=1e-4)
+
+
+def test_packed_requires_xla_attention():
+    import dataclasses
+    cfg = dataclasses.replace(TINY, attention_impl="flash")
+    params = transformer.init_params(cfg, jax.random.key(0))
+    toks, segs = pack_documents([[1, 2, 3]], 8)
+    with pytest.raises(ValueError, match="xla"):
+        transformer.forward(params, jnp.asarray(toks), cfg,
+                            jnp.asarray(segs))
