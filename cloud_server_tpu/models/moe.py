@@ -204,14 +204,9 @@ def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
     x = transformer.constrain(x, ("batch", "sequence", None))
     positions = None
     if segment_ids is not None:
-        if cfg.attention_impl != "xla":
-            raise ValueError(
-                f"packed segment_ids support requires attention_impl='xla' "
-                f"(got {cfg.attention_impl!r})")
         from cloud_server_tpu.ops.segments import positions_from_segments
-        from cloud_server_tpu.ops import causal_attention
         positions = positions_from_segments(segment_ids)
-        attn_fn = partial(causal_attention, segment_ids=segment_ids)
+        attn_fn = transformer._packed_attention_fn(cfg, segment_ids)
     else:
         attn_fn = transformer._get_attention_fn(cfg)
 
@@ -245,12 +240,7 @@ def next_token_loss(params: Params, batch: dict, cfg: ModelConfig,
                     z_loss_coef: float = 0.0, aux_loss_coef: float = 0.01,
                     router_z_coef: float = 0.0):
     seg = batch.get("segment_ids")
-    if seg is not None:
-        from cloud_server_tpu.ops.segments import segment_target_mask
-        tmask = segment_target_mask(seg)
-        if batch.get("mask") is not None:
-            tmask = tmask * batch["mask"].astype(tmask.dtype)
-        batch = {**batch, "mask": tmask}
+    batch = transformer.apply_segment_loss_mask(batch)
     if cfg.vocab_chunk > 0:
         x, aux = forward_hidden(params, batch["tokens"], cfg, segment_ids=seg)
         loss, metrics = transformer.fused_cross_entropy(

@@ -232,6 +232,34 @@ def _get_attention_fn(cfg: ModelConfig):
     raise ValueError(f"unknown attention_impl: {cfg.attention_impl!r}")
 
 
+def _packed_attention_fn(cfg: ModelConfig, segment_ids):
+    """attn_fn for a packed batch — the single dispatch point both model
+    families (dense here, MoE in models/moe.py) use, so segment support
+    for a new attention impl lands everywhere at once."""
+    if cfg.attention_impl == "xla":
+        return partial(causal_attention, segment_ids=segment_ids)
+    if cfg.attention_impl == "flash":
+        from cloud_server_tpu.ops.flash_attention import flash_attention
+        return partial(flash_attention, segment_ids=segment_ids)
+    raise ValueError(
+        f"packed segment_ids support requires attention_impl 'xla' or "
+        f"'flash' (got {cfg.attention_impl!r}); the ring/ulysses "
+        "sequence-parallel paths do not take a segment mask yet")
+
+
+def apply_segment_loss_mask(batch: dict) -> dict:
+    """If the batch is packed, fold the segment boundary/padding mask into
+    batch['mask'] (shared by the dense and MoE losses). No-op otherwise."""
+    seg = batch.get("segment_ids")
+    if seg is None:
+        return batch
+    from cloud_server_tpu.ops.segments import segment_target_mask
+    tmask = segment_target_mask(seg)
+    if batch.get("mask") is not None:
+        tmask = tmask * batch["mask"].astype(tmask.dtype)
+    return {**batch, "mask": tmask}
+
+
 def forward_hidden(params: Params, tokens: jnp.ndarray,
                    cfg: ModelConfig,
                    segment_ids: jnp.ndarray | None = None) -> jnp.ndarray:
@@ -250,14 +278,9 @@ def forward_hidden(params: Params, tokens: jnp.ndarray,
     x = constrain(x, ("batch", "sequence", None))
     positions = None
     if segment_ids is not None:
-        if cfg.attention_impl != "xla":
-            raise ValueError(
-                f"packed segment_ids support requires attention_impl='xla' "
-                f"(got {cfg.attention_impl!r}); the flash/ring/ulysses "
-                "paths do not take a segment mask yet")
         from cloud_server_tpu.ops.segments import positions_from_segments
         positions = positions_from_segments(segment_ids)
-        attn_fn = partial(causal_attention, segment_ids=segment_ids)
+        attn_fn = _packed_attention_fn(cfg, segment_ids)
     else:
         attn_fn = _get_attention_fn(cfg)
 
@@ -414,12 +437,7 @@ def next_token_loss(params: Params, batch: dict, cfg: ModelConfig,
     padding) are masked out of the loss.
     """
     seg = batch.get("segment_ids")
-    if seg is not None:
-        from cloud_server_tpu.ops.segments import segment_target_mask
-        tmask = segment_target_mask(seg)
-        if batch.get("mask") is not None:
-            tmask = tmask * batch["mask"].astype(tmask.dtype)
-        batch = {**batch, "mask": tmask}
+    batch = apply_segment_loss_mask(batch)
     if cfg.vocab_chunk > 0:
         x = forward_hidden(params, batch["tokens"], cfg, segment_ids=seg)
         return fused_cross_entropy(x, params, batch, cfg, z_loss_coef)

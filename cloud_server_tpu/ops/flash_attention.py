@@ -64,9 +64,12 @@ def _dot(a, b, dims):
 # Forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, block_q, block_kv,
-                kv_seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, block_q, block_kv,
+                kv_seq_len, has_seg):
+    if has_seg:
+        sq_ref, skv_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -89,7 +92,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             jnp.int32, (block_q, block_kv), 0)
         kv_pos = j * block_kv + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 1)
-        s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        mask = q_pos >= kv_pos
+        if has_seg:
+            # (bq, 1) rows vs (1, bkv) lanes -> (bq, bkv), no transpose
+            mask &= sq_ref[0, 0] == skv_ref[0, 0]
+        s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:, :1]  # (bq, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -112,23 +119,54 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                                          lse_ref.shape[2:])
 
 
-def _fwd(q, k, v, *, scale, block_q, block_kv, interpret):
+def _seg_views(segment_ids):
+    """(B, S) ids -> ((B,1,S,1) row view, (B,1,1,S) lane view). Rank-4 with
+    singleton trailing/leading dims keeps the block shapes Mosaic-legal
+    (same trick as LSE_LANES) and lets kernels compare (bq,1) == (1,bkv)
+    without an in-kernel transpose."""
+    return segment_ids[:, None, :, None], segment_ids[:, None, None, :]
+
+
+def _seg_specs(block_q, block_kv, qs_order=True):
+    """(row-view spec, lane-view spec); qs_order: grid is (..., i, j) with
+    q index first, else (..., j, i) kv-stationary."""
+    if qs_order:
+        row = pl.BlockSpec((1, 1, block_q, 1),
+                           lambda bi, hi, i, j: (bi, 0, i, 0))
+        lane = pl.BlockSpec((1, 1, 1, block_kv),
+                            lambda bi, hi, i, j: (bi, 0, 0, j))
+    else:
+        row = pl.BlockSpec((1, 1, block_q, 1),
+                           lambda bi, hi, j, i: (bi, 0, i, 0))
+        lane = pl.BlockSpec((1, 1, 1, block_kv),
+                            lambda bi, hi, j, i: (bi, 0, 0, j))
+    return row, lane
+
+
+def _fwd(q, k, v, segment_ids, *, scale, block_q, block_kv, interpret):
     b, h, sq, d = q.shape
     _, kh, skv, _ = k.shape
     g = h // kh
+    has_seg = segment_ids is not None
     grid = (b, h, pl.cdiv(sq, block_q), pl.cdiv(skv, block_kv))
 
     kv_spec = pl.BlockSpec((1, 1, block_kv, d),
                            lambda bi, hi, i, j: (bi, hi // g, j, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    inputs = [q, k, v]
+    if has_seg:
+        in_specs.extend(_seg_specs(block_q, block_kv))
+        inputs.extend(_seg_views(segment_ids))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
-                          block_kv=block_kv, kv_seq_len=skv),
+                          block_kv=block_kv, kv_seq_len=skv,
+                          has_seg=has_seg),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0)),
-            kv_spec,
-            kv_spec,
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0)),
             pl.BlockSpec((1, 1, block_q, LSE_LANES),
@@ -144,7 +182,7 @@ def _fwd(q, k, v, *, scale, block_q, block_kv, interpret):
             _vmem((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     # Named so remat policies can choose to save these instead of re-running
     # the kernel in the backward pass (see models/transformer.py remat="dots").
     from jax.ad_checkpoint import checkpoint_name
@@ -161,9 +199,12 @@ def _vmem(shape, dtype):
 # Backward kernels (flash-style recompute)
 # ---------------------------------------------------------------------------
 
-def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
-                     dk_ref, dv_ref, dk_acc, dv_acc, *,
-                     scale, block_q, block_kv):
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
+                     scale, block_q, block_kv, has_seg):
+    if has_seg:
+        sq_ref, skv_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
     j, i = pl.program_id(2), pl.program_id(3)  # kv-stationary: q innermost
 
     @pl.when(i == 0)
@@ -187,6 +228,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
         kv_pos = j * block_kv + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 1)
         mask = q_pos >= kv_pos
+        if has_seg:
+            mask &= sq_ref[0, 0] == skv_ref[0, 0]
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bkv)
 
         dv_acc[:] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
@@ -202,12 +245,16 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
-                      dq_ref, dk_ref, dv_ref, *, scale, sq):
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
+                      scale, sq, has_seg):
     """Whole-sequence backward: one grid cell per (batch, head) computes
     dq, dk, dv together, so s and p are built once instead of once per
     kernel. Only used when the sequence fits a single block (S <= block);
     the blocked two-kernel path below handles longer sequences."""
+    if has_seg:
+        sq_ref, skv_ref, dq_ref, dk_ref, dv_ref = refs
+    else:
+        dq_ref, dk_ref, dv_ref = refs
     q = q_ref[0, 0]
     k = k_ref[0, 0]
     v = v_ref[0, 0]
@@ -218,7 +265,10 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
     s = _dot(q, k, ((1,), (1,))) * scale
     q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
     kv_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
-    p = jnp.where(q_pos >= kv_pos, jnp.exp(s - lse), 0.0)
+    mask = q_pos >= kv_pos
+    if has_seg:
+        mask &= sq_ref[0, 0] == skv_ref[0, 0]
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)
 
     pc = p.astype(do.dtype)
     dv_ref[0, 0] = _dot(pc, do, ((0,), (0,))).astype(dv_ref.dtype)
@@ -229,8 +279,12 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
     dk_ref[0, 0] = _dot(ds, q, ((0,), (0,))).astype(dk_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
-                   dq_ref, dq_acc, *, scale, block_q, block_kv):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
+                   scale, block_q, block_kv, has_seg):
+    if has_seg:
+        sq_ref, skv_ref, dq_ref, dq_acc = refs
+    else:
+        dq_ref, dq_acc = refs
     i, j = pl.program_id(2), pl.program_id(3)  # q-stationary: kv innermost
 
     @pl.when(j == 0)
@@ -251,7 +305,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
             jnp.int32, (block_q, block_kv), 0)
         kv_pos = j * block_kv + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 1)
-        p = jnp.where(q_pos >= kv_pos, jnp.exp(s - lse), 0.0)
+        mask = q_pos >= kv_pos
+        if has_seg:
+            mask &= sq_ref[0, 0] == skv_ref[0, 0]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
 
         delta = jnp.sum(do.astype(jnp.float32) * o, axis=-1, keepdims=True)
         dp = _dot(do, v, ((1,), (1,)))
@@ -267,28 +324,29 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
 # custom_vjp wrapper
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, block_q, block_kv, interpret):
-    out, _ = _fwd(q, k, v, scale=scale, block_q=block_q, block_kv=block_kv,
-                  interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_bhsd(q, k, v, segment_ids, scale, block_q, block_kv, interpret):
+    out, _ = _fwd(q, k, v, segment_ids, scale=scale, block_q=block_q,
+                  block_kv=block_kv, interpret=interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, block_q, block_kv, interpret):
-    out, lse = _fwd(q, k, v, scale=scale, block_q=block_q,
+def _flash_fwd_rule(q, k, v, segment_ids, scale, block_q, block_kv,
+                    interpret):
+    out, lse = _fwd(q, k, v, segment_ids, scale=scale, block_q=block_q,
                     block_kv=block_kv, interpret=interpret)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, segment_ids, out, lse)
 
 
 def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
-    q, k, v, out, lse = res
+    q, k, v, segment_ids, out, lse = res
     b, h, sq, d = q.shape
     _, kh, skv, _ = k.shape
     g = h // kh
 
     if sq == skv and sq <= block_q and skv <= block_kv:
-        return _flash_bwd_fused(q, k, v, out, lse, do, scale=scale,
-                                interpret=interpret)
+        return _flash_bwd_fused(q, k, v, segment_ids, out, lse, do,
+                                scale=scale, interpret=interpret)
 
     nq, nkv = pl.cdiv(sq, block_q), pl.cdiv(skv, block_kv)
 
@@ -297,18 +355,23 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
                               lambda bi, hi, i, j: (bi, hi // g, j, 0))
     lse_spec_qs = pl.BlockSpec((1, 1, block_q, LSE_LANES),
                                lambda bi, hi, i, j: (bi, hi, i, 0))
+    has_seg = segment_ids is not None
+    seg_inputs = list(_seg_views(segment_ids)) if has_seg else []
 
+    dq_in_specs = [q_spec_qs, kv_spec_qs, kv_spec_qs, q_spec_qs, lse_spec_qs,
+                   q_spec_qs]
+    if has_seg:
+        dq_in_specs.extend(_seg_specs(block_q, block_kv))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
-                          block_kv=block_kv),
+                          block_kv=block_kv, has_seg=has_seg),
         grid=(b, h, nq, nkv),
-        in_specs=[q_spec_qs, kv_spec_qs, kv_spec_qs, q_spec_qs, lse_spec_qs,
-                  q_spec_qs],
+        in_specs=dq_in_specs,
         out_specs=q_spec_qs,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[_vmem((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, out, lse, do)
+    )(q, k, v, out, lse, do, *seg_inputs)
 
     # kv-stationary grid for dk/dv: one pass per (kv block), q innermost.
     # Outputs are per *q-head*; sum over the group afterwards for GQA.
@@ -320,58 +383,76 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
     dkv_out_spec = pl.BlockSpec((1, 1, block_kv, d),
                                 lambda bi, hi, j, i: (bi, hi, j, 0))
 
+    dkdv_in_specs = [q_spec_ks, kv_spec_ks, kv_spec_ks, q_spec_ks,
+                     lse_spec_ks, q_spec_ks]
+    if has_seg:
+        dkdv_in_specs.extend(_seg_specs(block_q, block_kv, qs_order=False))
     dk_h, dv_h = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, block_q=block_q,
-                          block_kv=block_kv),
+                          block_kv=block_kv, has_seg=has_seg),
         grid=(b, h, nkv, nq),
-        in_specs=[q_spec_ks, kv_spec_ks, kv_spec_ks, q_spec_ks, lse_spec_ks,
-                  q_spec_ks],
+        in_specs=dkdv_in_specs,
         out_specs=[dkv_out_spec, dkv_out_spec],
         out_shape=[jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32),
                    jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32)],
         scratch_shapes=[_vmem((block_kv, d), jnp.float32),
                         _vmem((block_kv, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, out, lse, do)
+    )(q, k, v, out, lse, do, *seg_inputs)
 
     dk = dk_h.reshape(b, kh, g, skv, d).sum(axis=2).astype(k.dtype)
     dv = dv_h.reshape(b, kh, g, skv, d).sum(axis=2).astype(v.dtype)
-    return dq, dk, dv
+    return dq, dk, dv, None
 
 
-def _flash_bwd_fused(q, k, v, out, lse, do, *, scale, interpret):
+def _flash_bwd_fused(q, k, v, segment_ids, out, lse, do, *, scale,
+                     interpret):
     b, h, sq, d = q.shape
     _, kh, _, _ = k.shape
     g = h // kh
+    has_seg = segment_ids is not None
 
     q_spec = pl.BlockSpec((1, 1, sq, d), lambda bi, hi: (bi, hi, 0, 0))
     kv_spec = pl.BlockSpec((1, 1, sq, d), lambda bi, hi: (bi, hi // g, 0, 0))
     lse_spec = pl.BlockSpec((1, 1, sq, LSE_LANES),
                             lambda bi, hi: (bi, hi, 0, 0))
+    in_specs = [q_spec, kv_spec, kv_spec, q_spec, lse_spec, q_spec]
+    inputs = [q, k, v, out, lse, do]
+    if has_seg:
+        in_specs.append(pl.BlockSpec((1, 1, sq, 1),
+                                     lambda bi, hi: (bi, 0, 0, 0)))
+        in_specs.append(pl.BlockSpec((1, 1, 1, sq),
+                                     lambda bi, hi: (bi, 0, 0, 0)))
+        inputs.extend(_seg_views(segment_ids))
 
     dq, dk_h, dv_h = pl.pallas_call(
-        functools.partial(_bwd_fused_kernel, scale=scale, sq=sq),
+        functools.partial(_bwd_fused_kernel, scale=scale, sq=sq,
+                          has_seg=has_seg),
         grid=(b, h),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, lse_spec, q_spec],
+        in_specs=in_specs,
         out_specs=[q_spec, q_spec, q_spec],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
                    jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
                    jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, out, lse, do)
+    )(*inputs)
     dk = dk_h.reshape(b, kh, g, sq, d).sum(axis=2).astype(k.dtype)
     dv = dv_h.reshape(b, kh, g, sq, d).sum(axis=2).astype(v.dtype)
-    return dq, dk, dv
+    return dq, dk, dv, None
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(q, k, v, *, scale=None, block_q: int = 1024,
-                    block_kv: int = 1024, interpret: bool | None = None):
+                    block_kv: int = 1024, interpret: bool | None = None,
+                    segment_ids=None):
     """Causal flash attention, (B, S, H, Dh) layout like ops.attention.
 
     q: (B, S, H, Dh); k, v: (B, S, KH, Dh). Returns (B, S, H, Dh).
+    segment_ids: optional (B, S) int32 packed-sequence ids — attention is
+    additionally masked to same-segment pairs (block-diagonal causal; see
+    data/packing.py), in forward and backward.
     """
     b, sq, h, d = q.shape
     if scale is None:
@@ -383,5 +464,7 @@ def flash_attention(q, k, v, *, scale=None, block_q: int = 1024,
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash_bhsd(qt, kt, vt, scale, block_q, block_kv, interpret)
+    seg = (None if segment_ids is None
+           else jnp.asarray(segment_ids, jnp.int32))
+    out = _flash_bhsd(qt, kt, vt, seg, scale, block_q, block_kv, interpret)
     return out.transpose(0, 2, 1, 3)

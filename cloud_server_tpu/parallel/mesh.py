@@ -22,10 +22,10 @@ from cloud_server_tpu.config import MeshConfig
 _CURRENT_MESH: Mesh | None = None
 
 
-def set_current_mesh(mesh: Mesh) -> Mesh:
-    """Register the process-wide mesh. Model code that needs mesh context
-    outside an explicit shard_map (e.g. attention_impl="ring") reads it via
-    `current_mesh()`."""
+def set_current_mesh(mesh: Mesh | None) -> Mesh | None:
+    """Register the process-wide mesh (None clears it). Model code that
+    needs mesh context outside an explicit shard_map (e.g.
+    attention_impl="ring") reads it via `current_mesh()`."""
     global _CURRENT_MESH
     _CURRENT_MESH = mesh
     return mesh

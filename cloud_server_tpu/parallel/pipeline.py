@@ -280,6 +280,12 @@ def make_pipelined_loss(model_cfg, mesh: Mesh, num_microbatches: int,
         # runtime cfg so the head/softcap/chunking can't silently diverge
         # from the pipelined body.
         del cfg
+        if batch.get("segment_ids") is not None:
+            raise ValueError(
+                "packed batches (segment_ids) are not supported by the "
+                "pipelined loss yet — attention would silently cross "
+                "document boundaries; train packed batches with the "
+                "unpipelined path")
         out = hidden(params, batch["tokens"])
         x, aux = out if is_moe else (out, None)
         if model_cfg.vocab_chunk > 0:

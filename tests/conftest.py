@@ -40,3 +40,15 @@ def devices8():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(autouse=True)
+def _clear_registered_mesh():
+    """Test isolation for the process-wide mesh: a test that builds a
+    sharded mesh (make_mesh registers it globally) must not leak it into a
+    later test's single-device jits — `constrain` would anchor their
+    activations to a mesh whose axis sizes don't divide the tiny test
+    shapes."""
+    yield
+    from cloud_server_tpu.parallel.mesh import set_current_mesh
+    set_current_mesh(None)

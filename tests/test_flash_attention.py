@@ -94,6 +94,38 @@ def test_compiled_on_tpu():
                                    err_msg=f"d{name}")
 
 
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="compiled-mode Mosaic lowering needs a real TPU")
+def test_segments_compiled_on_tpu():
+    """The segment-mask variant must also lower on-chip (its extra
+    (bq,1)/(1,bkv) seg block specs are exactly the shape class that broke
+    the r1 LSE spec) — fwd and all three bwd kernels."""
+    q, k, v = _rand_qkv(6, 2, 512, 8, 4, 64)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    segs = jnp.asarray(
+        np.repeat([[1] * 200 + [2] * 250 + [0] * 62], 2, axis=0))
+    got = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, segment_ids=segs, block_q=256, block_kv=256))(q, k, v)
+    want = causal_attention(q, k, v, segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), atol=3e-2)
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, segment_ids=segs, block_q=256,
+                                block_kv=256).astype(jnp.float32) ** 2).sum()
+
+    def f_dense(q, k, v):
+        return (causal_attention(q, k, v, segment_ids=segs
+                                 ).astype(jnp.float32) ** 2).sum()
+
+    gf = jax.jit(jax.grad(f_flash, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.jit(jax.grad(f_dense, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=0.15,
+                                   err_msg=f"d{name}")
+
+
 @pytest.mark.parametrize("h,kh", [(4, 4), (4, 2)])
 def test_backward_fused_single_block(h, kh):
     """S <= block takes the fused one-pass dq/dk/dv kernel; it must match
