@@ -14,6 +14,13 @@ Config notes (measured on TPU v5e, this repo):
     via checkpoint_name): 312 -> ~229 ms/step vs the r1 XLA-attention path.
   * the S=2048 extra compares the pallas flash kernel against XLA dense
     attention at long sequence in a training-style fwd+bwd.
+  * r2 sweep results at this config (kept for provenance, all slower or
+    invalid): vocab_chunk 4k/8k ~+4%, remat="attn" ~+4%, flash blocks
+    512/512 +10% (the 1024 single-block fused-bwd path wins), remat="none"
+    fails to compile even with flash, bf16 master params -5% but changes
+    optimizer numerics. Step decomposition: fwd 62 ms, bwd ~145 ms,
+    optimizer 18 ms (near bandwidth-bound: ~9 GB of f32 param/moment
+    traffic).
 """
 
 from __future__ import annotations

@@ -102,6 +102,12 @@ class MeshConfig:
 @dataclasses.dataclass(frozen=True)
 class TrainConfig:
     learning_rate: float = 3e-4
+    # "warmup_cosine" | "wsd" (warmup-stable-decay: hold peak, then a
+    # linear cooldown over the last lr_decay_frac of training — the
+    # schedule that lets one run branch into many cooldown lengths) |
+    # "constant" (warmup then hold)
+    lr_schedule: str = "warmup_cosine"
+    lr_decay_frac: float = 0.1  # wsd cooldown fraction of total_steps
     warmup_steps: int = 100
     total_steps: int = 1000
     weight_decay: float = 0.1

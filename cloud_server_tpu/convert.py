@@ -1,0 +1,114 @@
+"""Checkpoint conversion CLI: `python -m cloud_server_tpu.convert`.
+
+Export a framework checkpoint to a HuggingFace LLaMA-family directory
+(loadable with `transformers.AutoModelForCausalLM.from_pretrained`), the
+inverse of `generate.py --hf-checkpoint`. Completes round-trip interop:
+bring weights in, train/fine-tune here, take them back out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m cloud_server_tpu.convert",
+        description="Export a framework checkpoint to a HuggingFace "
+        "LLaMA-family directory.")
+    p.add_argument("--config", required=True,
+                   help="JSON config with the model section used at "
+                   "training time")
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--step", type=int, help="checkpoint step (default latest)")
+    p.add_argument("--out", required=True, metavar="DIR",
+                   help="output HF checkpoint directory")
+    p.add_argument("--ema", action="store_true",
+                   help="export the EMA-averaged weights (needs a run "
+                   "trained with ema_decay > 0)")
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+
+    from cloud_server_tpu.config import (MeshConfig, ModelConfig, from_json)
+    from cloud_server_tpu.models.hf_convert import params_to_hf
+    from cloud_server_tpu.parallel.mesh import make_mesh
+
+    with open(args.config) as f:
+        raw = json.load(f)
+    model_cfg = from_json(ModelConfig, raw.get("model", {}))
+    if model_cfg.num_experts >= 2:
+        raise SystemExit(
+            "HF export supports the dense LLaMA family only (the MoE "
+            "layout has no LlamaForCausalLM equivalent)")
+
+    mesh = make_mesh(MeshConfig())
+    if args.ema:
+        from cloud_server_tpu.training.checkpoint import restore_ema_params
+        try:
+            params = restore_ema_params(args.checkpoint_dir, model_cfg,
+                                        mesh, step=args.step)
+        except FileNotFoundError as e:
+            raise SystemExit(str(e))
+    else:
+        from cloud_server_tpu.training.checkpoint import restore_params
+        params = restore_params(args.checkpoint_dir, model_cfg, mesh,
+                                step=args.step)
+
+    state_dict = params_to_hf(params, model_cfg)
+
+    import transformers
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=model_cfg.vocab_size,
+        hidden_size=model_cfg.embed_dim,
+        intermediate_size=model_cfg.mlp_dim,
+        num_hidden_layers=model_cfg.num_layers,
+        num_attention_heads=model_cfg.num_heads,
+        num_key_value_heads=model_cfg.num_kv_heads,
+        head_dim=model_cfg.head_dim,
+        max_position_embeddings=model_cfg.max_seq_len,
+        rms_norm_eps=model_cfg.norm_eps,
+        rope_theta=model_cfg.rope_theta,
+        tie_word_embeddings=model_cfg.tie_embeddings,
+        attention_bias=False, mlp_bias=False, hidden_act="silu")
+    if model_cfg.rope_scaling == "linear":
+        hf_cfg.rope_scaling = {"rope_type": "linear",
+                               "factor": model_cfg.rope_scaling_factor}
+    elif model_cfg.rope_scaling == "llama3":
+        hf_cfg.rope_scaling = {
+            "rope_type": "llama3",
+            "factor": model_cfg.rope_scaling_factor,
+            "low_freq_factor": model_cfg.rope_low_freq_factor,
+            "high_freq_factor": model_cfg.rope_high_freq_factor,
+            "original_max_position_embeddings":
+                model_cfg.rope_original_max_len}
+
+    import torch
+
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    missing, unexpected = model.load_state_dict(
+        {k: torch.from_numpy(v.copy()) for k, v in state_dict.items()},
+        strict=False)
+    # rotary buffers are recomputed, and with tied embeddings HF derives
+    # lm_head.weight from the embedding (params_to_hf rightly omits it;
+    # raw load_state_dict has no tying awareness). Anything else missing
+    # is a bug.
+    real_missing = [k for k in missing
+                    if "rotary_emb" not in k
+                    and not (model_cfg.tie_embeddings
+                             and k == "lm_head.weight")]
+    if real_missing or unexpected:
+        raise SystemExit(
+            f"export mismatch: missing={real_missing} "
+            f"unexpected={unexpected}")
+    model.save_pretrained(args.out)
+    print(f"[convert] wrote HF checkpoint to {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -63,6 +63,52 @@ class MemmapTokenDataset:
                                      np.int32)}
 
 
+class MixtureDataset:
+    """Deterministic weighted mixture over several map-style datasets.
+
+    Pretraining corpora are usually a weighted blend (web + code + books,
+    ...). Example i draws its source from a hash-seeded categorical over
+    `weights` and then a uniformly random example index WITH REPLACEMENT
+    within that source — both pure functions of (seed, i), so the mixture
+    composes with the resumable sharded sampler exactly like a plain
+    dataset: restoring a step replays the identical blend. I.i.d.
+    sampling means there is no per-source epoch traversal or coverage
+    guarantee (a pass over len(self) indices repeats some examples and
+    misses others — the standard choice for weighted pretraining blends,
+    where small high-weight corpora must repeat anyway); the default
+    length is just the unweighted example count across sources, a
+    bookkeeping convention for "one nominal epoch".
+    """
+
+    def __init__(self, datasets, weights, *, num_examples: int | None = None,
+                 seed: int = 0):
+        if len(datasets) != len(weights) or not datasets:
+            raise ValueError("need equally many datasets and weights (>=1)")
+        w = np.asarray(weights, np.float64)
+        if (w <= 0).any():
+            raise ValueError(f"weights must be positive, got {weights}")
+        self._datasets = list(datasets)
+        self._cum = np.cumsum(w / w.sum())
+        self.seq_len = datasets[0].seq_len
+        self.seed = seed
+        # default: one epoch of the mixture touches as many examples as
+        # the weighted sources would supply
+        self._n = num_examples or int(
+            sum(len(d) for d in self._datasets))
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i: int) -> dict[str, np.ndarray]:
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        rng = np.random.default_rng((self.seed, 0x6D69, i))
+        src = int(np.searchsorted(self._cum, rng.random(), side="right"))
+        src = min(src, len(self._datasets) - 1)
+        ds = self._datasets[src]
+        return ds[int(rng.integers(0, len(ds)))]
+
+
 class SyntheticLMDataset:
     """Deterministic random tokens — for tests and benches (no disk IO)."""
 

@@ -25,7 +25,10 @@ def build_parser() -> argparse.ArgumentParser:
         description="Train a dense or MoE decoder LM on TPU.")
     p.add_argument("--config", help="JSON config file with optional "
                    "model/train/mesh/loop sections")
-    p.add_argument("--data", help="flat binary token file (uint16)")
+    p.add_argument("--data", action="append", default=None,
+                   help="flat binary token file (uint16). Repeatable; "
+                   "with several, pass 'path:weight' to train on a "
+                   "deterministic weighted mixture (weight defaults to 1)")
     p.add_argument("--eval-data", help="eval token file (same format)")
     p.add_argument("--synthetic", type=int, default=0, metavar="N",
                    help="use N synthetic random examples instead of --data")
@@ -102,7 +105,7 @@ def main(argv=None) -> None:
         initialize()
 
     from cloud_server_tpu.data.dataset import (
-        MemmapTokenDataset, SyntheticLMDataset)
+        MemmapTokenDataset, MixtureDataset, SyntheticLMDataset)
     from cloud_server_tpu.models import moe as moe_module, transformer
     from cloud_server_tpu.training.loop import train_loop
 
@@ -125,7 +128,21 @@ def main(argv=None) -> None:
                                      model_cfg.vocab_size,
                                      seed=train_cfg.seed)
     elif args.data:
-        dataset = MemmapTokenDataset(args.data, train_cfg.seq_len)
+        specs = []
+        for entry in args.data:
+            path, _, w = entry.rpartition(":")
+            try:
+                weight, path = (float(w), path) if path else (1.0, entry)
+            except ValueError:
+                weight, path = 1.0, entry  # ':' was part of the path
+            specs.append((path, weight))
+        if len(specs) == 1:
+            dataset = MemmapTokenDataset(specs[0][0], train_cfg.seq_len)
+        else:
+            dataset = MixtureDataset(
+                [MemmapTokenDataset(p, train_cfg.seq_len)
+                 for p, _ in specs],
+                [w for _, w in specs], seed=train_cfg.seed)
     else:
         raise SystemExit("one of --data or --synthetic is required")
     eval_dataset = (MemmapTokenDataset(args.eval_data, train_cfg.seq_len)

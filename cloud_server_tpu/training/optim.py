@@ -39,13 +39,34 @@ def _decay_mask(params):
 
 
 def make_schedule(cfg: TrainConfig) -> optax.Schedule:
-    return optax.warmup_cosine_decay_schedule(
-        init_value=0.0,
-        peak_value=cfg.learning_rate,
-        warmup_steps=cfg.warmup_steps,
-        decay_steps=max(cfg.total_steps, cfg.warmup_steps + 1),
-        end_value=cfg.learning_rate * 0.1,
-    )
+    if cfg.lr_schedule == "warmup_cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=cfg.learning_rate,
+            warmup_steps=cfg.warmup_steps,
+            decay_steps=max(cfg.total_steps, cfg.warmup_steps + 1),
+            end_value=cfg.learning_rate * 0.1,
+        )
+    if cfg.lr_schedule == "constant":
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, cfg.learning_rate,
+                                   max(1, cfg.warmup_steps)),
+             optax.constant_schedule(cfg.learning_rate)],
+            boundaries=[cfg.warmup_steps])
+    if cfg.lr_schedule == "wsd":
+        # warmup -> stable at peak -> linear cooldown to ~0 over the last
+        # lr_decay_frac of total_steps
+        decay_steps = max(1, int(cfg.total_steps * cfg.lr_decay_frac))
+        stable_steps = max(0, cfg.total_steps - cfg.warmup_steps
+                           - decay_steps)
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, cfg.learning_rate,
+                                   max(1, cfg.warmup_steps)),
+             optax.constant_schedule(cfg.learning_rate),
+             optax.linear_schedule(cfg.learning_rate,
+                                   cfg.learning_rate * 0.01, decay_steps)],
+            boundaries=[cfg.warmup_steps, cfg.warmup_steps + stable_steps])
+    raise ValueError(f"unknown lr_schedule: {cfg.lr_schedule!r}")
 
 
 class FusedAdamWState(NamedTuple):

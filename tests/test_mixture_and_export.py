@@ -1,0 +1,179 @@
+"""MixtureDataset determinism/weighting, WSD schedule shape, and the
+framework -> HF export CLI round trip."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from cloud_server_tpu.config import TrainConfig
+from cloud_server_tpu.data.dataset import MixtureDataset, SyntheticLMDataset
+from cloud_server_tpu.training.optim import make_schedule
+
+
+def test_mixture_deterministic_and_weighted():
+    a = SyntheticLMDataset(100, 16, 50, seed=1)
+    b = SyntheticLMDataset(100, 16, 50, seed=2)
+    mix = MixtureDataset([a, b], [0.9, 0.1], seed=0)
+    assert len(mix) == 200
+    # deterministic: same index, same example
+    np.testing.assert_array_equal(mix[7]["tokens"], mix[7]["tokens"])
+    mix2 = MixtureDataset([a, b], [0.9, 0.1], seed=0)
+    np.testing.assert_array_equal(mix[7]["tokens"], mix2[7]["tokens"])
+
+    # weighting: count which source each example came from by matching
+    src_a = {a[i]["tokens"].tobytes() for i in range(100)}
+    n_a = sum(mix[i]["tokens"].tobytes() in src_a for i in range(200))
+    assert 160 <= n_a <= 198  # ~0.9 of 200
+
+
+def test_mixture_works_with_loader(devices8):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cloud_server_tpu.config import MeshConfig
+    from cloud_server_tpu.data.loader import DataLoader
+    from cloud_server_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(MeshConfig(fsdp=8))
+    sharding = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    mix = MixtureDataset(
+        [SyntheticLMDataset(32, 16, 50, seed=1),
+         SyntheticLMDataset(32, 16, 50, seed=2)], [1, 1], seed=0)
+    loader = DataLoader(mix, 8, sharding, seed=0, prefetch=0)
+    batch = next(iter(loader))
+    assert batch["tokens"].shape == (8, 16)
+
+
+def test_mixture_validates():
+    a = SyntheticLMDataset(10, 16, 50)
+    with pytest.raises(ValueError, match="positive"):
+        MixtureDataset([a, a], [1.0, 0.0])
+    with pytest.raises(ValueError, match="equally"):
+        MixtureDataset([a], [1.0, 2.0])
+
+
+def test_wsd_schedule_shape():
+    cfg = TrainConfig(learning_rate=1e-3, warmup_steps=10, total_steps=100,
+                      lr_schedule="wsd", lr_decay_frac=0.2)
+    sched = make_schedule(cfg)
+    assert float(sched(0)) == 0.0
+    assert float(sched(10)) == pytest.approx(1e-3, rel=1e-3)
+    assert float(sched(50)) == pytest.approx(1e-3)  # stable plateau
+    assert float(sched(79)) == pytest.approx(1e-3)  # last stable step
+    assert float(sched(99)) < 1e-4  # deep in the cooldown
+    cfg_c = TrainConfig(learning_rate=1e-3, warmup_steps=10,
+                        total_steps=100, lr_schedule="constant")
+    assert float(make_schedule(cfg_c)(99)) == pytest.approx(1e-3)
+    with pytest.raises(ValueError, match="lr_schedule"):
+        make_schedule(TrainConfig(lr_schedule="nope"))
+
+
+def test_train_cli_mixture(tmp_path, devices8):
+    """--data a.bin:3 --data b.bin:1 trains on the weighted mixture."""
+    from cloud_server_tpu.data.tokenizer import main as tokenize_main
+    from cloud_server_tpu.train import main as train_main
+
+    (tmp_path / "a.txt").write_text("abcdefgh\n" * 200)
+    (tmp_path / "b.txt").write_text("12345678\n" * 200)
+    tokenize_main([str(tmp_path / "a.txt"), str(tmp_path / "a.bin")])
+    tokenize_main([str(tmp_path / "b.txt"), str(tmp_path / "b.bin")])
+    cfg = {"model": {"vocab_size": 300, "embed_dim": 32, "num_layers": 2,
+                     "num_heads": 4, "num_kv_heads": 2, "head_dim": 8,
+                     "mlp_dim": 64, "max_seq_len": 64, "dtype": "float32",
+                     "param_dtype": "float32", "remat": "none"},
+           "train": {"total_steps": 5, "batch_size": 8, "seq_len": 16,
+                     "warmup_steps": 1, "learning_rate": 0.01},
+           "loop": {"log_interval": 5}}
+    (tmp_path / "cfg.json").write_text(json.dumps(cfg))
+    train_main(["--config", str(tmp_path / "cfg.json"),
+                "--data", f"{tmp_path / 'a.bin'}:3",
+                "--data", f"{tmp_path / 'b.bin'}:1",
+                "--checkpoint-dir", str(tmp_path / "ckpt")])
+    assert (tmp_path / "ckpt").exists()
+
+
+def test_export_tied_embeddings(tmp_path, devices8):
+    """tie_embeddings export must not trip the missing-keys check
+    (params_to_hf rightly omits lm_head.weight; HF derives it)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from cloud_server_tpu.config import ModelConfig
+    from cloud_server_tpu.convert import main as convert_main
+    from cloud_server_tpu.models import transformer
+    from cloud_server_tpu.training.checkpoint import Checkpointer
+    from cloud_server_tpu.training.train_step import init_train_state
+    from cloud_server_tpu.parallel.mesh import make_mesh
+    from cloud_server_tpu.config import MeshConfig, TrainConfig
+
+    model = {"vocab_size": 300, "embed_dim": 32, "num_layers": 2,
+             "num_heads": 4, "num_kv_heads": 2, "head_dim": 8,
+             "mlp_dim": 64, "max_seq_len": 64, "dtype": "float32",
+             "param_dtype": "float32", "remat": "none",
+             "tie_embeddings": True}
+    cfg = ModelConfig(**model)
+    mesh = make_mesh(MeshConfig())
+    state = init_train_state(cfg, TrainConfig(), mesh, jax.random.key(0))
+    with Checkpointer(tmp_path / "ckpt") as ck:
+        assert ck.save(state)
+        ck.wait()
+    (tmp_path / "cfg.json").write_text(json.dumps({"model": model}))
+    convert_main(["--config", str(tmp_path / "cfg.json"),
+                  "--checkpoint-dir", str(tmp_path / "ckpt"),
+                  "--out", str(tmp_path / "hf")])
+
+    hf = transformers.AutoModelForCausalLM.from_pretrained(
+        str(tmp_path / "hf")).eval()
+    tokens = np.array([[5, 9, 3, 17]], np.int32)
+    ours = np.asarray(transformer.forward(
+        state.params, jax.numpy.asarray(tokens), cfg))
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=3e-4)
+
+
+def test_export_roundtrip_logits(tmp_path, devices8):
+    """Train briefly, export to HF, reload with transformers, and check
+    logits parity against our forward."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from cloud_server_tpu.config import ModelConfig, from_json
+    from cloud_server_tpu.convert import main as convert_main
+    from cloud_server_tpu.data.tokenizer import main as tokenize_main
+    from cloud_server_tpu.models import transformer
+    from cloud_server_tpu.train import main as train_main
+    from cloud_server_tpu.training.checkpoint import restore_params
+    from cloud_server_tpu.parallel.mesh import make_mesh
+    from cloud_server_tpu.config import MeshConfig
+
+    (tmp_path / "corpus.txt").write_text("abcdefgh\n" * 200)
+    cfg = {"model": {"vocab_size": 300, "embed_dim": 32, "num_layers": 2,
+                     "num_heads": 4, "num_kv_heads": 2, "head_dim": 8,
+                     "mlp_dim": 64, "max_seq_len": 64, "dtype": "float32",
+                     "param_dtype": "float32", "remat": "none"},
+           "train": {"total_steps": 5, "batch_size": 8, "seq_len": 16,
+                     "warmup_steps": 1, "learning_rate": 0.01},
+           "loop": {"log_interval": 5}}
+    (tmp_path / "cfg.json").write_text(json.dumps(cfg))
+    tokenize_main([str(tmp_path / "corpus.txt"), str(tmp_path / "t.bin")])
+    train_main(["--config", str(tmp_path / "cfg.json"),
+                "--data", str(tmp_path / "t.bin"),
+                "--checkpoint-dir", str(tmp_path / "ckpt")])
+    convert_main(["--config", str(tmp_path / "cfg.json"),
+                  "--checkpoint-dir", str(tmp_path / "ckpt"),
+                  "--out", str(tmp_path / "hf")])
+
+    model_cfg = from_json(ModelConfig, cfg["model"])
+    params = restore_params(str(tmp_path / "ckpt"), model_cfg,
+                            make_mesh(MeshConfig()))
+    tokens = np.array([[5, 9, 3, 17, 60, 2]], np.int32)
+    ours = np.asarray(transformer.forward(
+        params, jax.numpy.asarray(tokens), model_cfg))
+
+    hf = transformers.AutoModelForCausalLM.from_pretrained(
+        str(tmp_path / "hf")).eval()
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=3e-4)
