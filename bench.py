@@ -171,27 +171,32 @@ def serving_bench():
     prompts = [list(range(1, 65)) for _ in range(8)]
 
     chunk = 32  # multi-token scheduling: one host sync per 32 decode steps
+    weights = {"bf16": params_bf16, "int8": params_int8}
+    modes = [(impl, wname, "model") for impl in ("xla", "pallas")
+             for wname in ("bf16", "int8")]
+    modes.append(("xla", "bf16", "int8"))  # int8 KV cache
     out = {}
-    for impl in ("xla", "pallas"):
-        for wname, params in (("bf16", params_bf16), ("int8", params_int8)):
-            cfg = dataclasses.replace(base, decode_attention_impl=impl)
-            srv = InferenceServer(params, cfg, infer_cfg, max_slots=8,
-                                  max_len=1024, prompt_buckets=[64],
-                                  decode_chunk=chunk)
-            for p in prompts:
-                srv.submit(p, max_new_tokens=900)
-            for _ in range(3):  # admit + warm the decode jit
-                srv.step()
-            n = 8
-            tokens_before = sum(len(r.tokens) for r in srv._slots if r)
-            t0 = time.perf_counter()
-            for _ in range(n):
-                srv.step()
-            dt = time.perf_counter() - t0
-            tokens_after = sum(len(r.tokens) for r in srv._slots if r)
-            out[f"decode_tok_s_{impl}_{wname}"] = (
-                (tokens_after - tokens_before) / dt)
-            del srv, cfg
+    for impl, wname, kv in modes:
+        cfg = dataclasses.replace(base, decode_attention_impl=impl,
+                                  kv_cache_dtype=kv)
+        srv = InferenceServer(weights[wname], cfg, infer_cfg, max_slots=8,
+                              max_len=1024, prompt_buckets=[64],
+                              decode_chunk=chunk)
+        for p in prompts:
+            srv.submit(p, max_new_tokens=900)
+        for _ in range(3):  # admit + warm the decode jit
+            srv.step()
+        n = 8
+        tokens_before = sum(len(r.tokens) for r in srv._slots if r)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            srv.step()
+        dt = time.perf_counter() - t0
+        tokens_after = sum(len(r.tokens) for r in srv._slots if r)
+        tag = f"decode_tok_s_{impl}_{wname}" + (
+            "_kvint8" if kv == "int8" else "")
+        out[tag] = (tokens_after - tokens_before) / dt
+        del srv, cfg
     return out
 
 

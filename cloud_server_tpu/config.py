@@ -43,6 +43,10 @@ class ModelConfig:
     attention_impl: str = "xla"
     # decode-time (cached, single-query) attention: "xla" | "pallas"
     decode_attention_impl: str = "xla"
+    # KV-cache storage: "model" (cfg.dtype) | "int8" (symmetric per-head
+    # absmax quantization — halves cache bytes/decode bandwidth at long
+    # context; xla decode path only)
+    kv_cache_dtype: str = "model"
     # mixture of experts (0 experts => dense MLP)
     num_experts: int = 0
     num_experts_per_token: int = 2

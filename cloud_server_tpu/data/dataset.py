@@ -91,10 +91,11 @@ class MixtureDataset:
         self._cum = np.cumsum(w / w.sum())
         self.seq_len = datasets[0].seq_len
         self.seed = seed
-        # default: one epoch of the mixture touches as many examples as
-        # the weighted sources would supply
-        self._n = num_examples or int(
-            sum(len(d) for d in self._datasets))
+        if num_examples is not None and num_examples <= 0:
+            raise ValueError(f"num_examples must be > 0, got {num_examples}")
+        # default "nominal epoch" length: the unweighted example count
+        self._n = (num_examples if num_examples is not None
+                   else int(sum(len(d) for d in self._datasets)))
 
     def __len__(self) -> int:
         return self._n

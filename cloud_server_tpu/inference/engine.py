@@ -39,9 +39,34 @@ from cloud_server_tpu.ops import causal_attention, rms_norm, rope_table
 
 
 class KVCache(NamedTuple):
-    k: jnp.ndarray  # (L, B, max_len, KH, Dh)
+    k: jnp.ndarray  # (L, B, max_len, KH, Dh) — cfg.dtype, or int8 when
+    #                 cfg.kv_cache_dtype == "int8"
     v: jnp.ndarray  # (L, B, max_len, KH, Dh)
     length: jnp.ndarray  # (B,) int32 — valid entries per sequence
+    # int8 mode only: per-(position, head) absmax scales, else None
+    k_scale: jnp.ndarray | None = None  # (L, B, max_len, KH, 1) f32
+    v_scale: jnp.ndarray | None = None
+
+
+def _kv_quant(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization over the last (head_dim) axis.
+
+    Per-(position, head) absmax scaling keeps error ~0.5% while halving
+    cache MEMORY vs bf16 — the cap on concurrent slots x context. Note
+    the measured v5e decode cost is ~+20% (the dequantized per-layer
+    copy materialises in HBM; see docs/serving.md) — use when memory,
+    not latency, is the binding constraint."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                    keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(x.astype(jnp.float32) / scale)
+    return q.astype(jnp.int8), scale
+
+
+def _kv_dequant(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Inverse of `_kv_quant`; XLA fuses this into the attention matmul
+    that consumes it, so no dequantized cache copy lands in HBM."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
 def _mlp_apply(x, lp, cfg: ModelConfig):
@@ -62,6 +87,17 @@ def _mlp_apply(x, lp, cfg: ModelConfig):
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:
     shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.kv_cache_dtype == "int8":
+        sshape = shape[:-1] + (1,)
+        return KVCache(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       length=jnp.zeros((batch,), jnp.int32),
+                       k_scale=jnp.zeros(sshape, jnp.float32),
+                       v_scale=jnp.zeros(sshape, jnp.float32))
+    if cfg.kv_cache_dtype != "model":
+        raise ValueError(
+            f"unknown kv_cache_dtype: {cfg.kv_cache_dtype!r} "
+            "(expected 'model' or 'int8')")
     dtype = jnp.dtype(cfg.dtype)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
                    length=jnp.zeros((batch,), jnp.int32))
@@ -107,6 +143,15 @@ def prefill(params, tokens: jnp.ndarray, cfg: ModelConfig, cache: KVCache,
         x_last = x[jnp.arange(b), lengths - 1]
     logits = transformer.unembed(x_last, params, cfg)
 
+    if cfg.kv_cache_dtype == "int8":
+        kq, ksc = _kv_quant(ks)
+        vq, vsc = _kv_quant(vs)
+        return logits, KVCache(
+            lax.dynamic_update_slice(cache.k, kq, (0, 0, 0, 0, 0)),
+            lax.dynamic_update_slice(cache.v, vq, (0, 0, 0, 0, 0)),
+            lengths,
+            lax.dynamic_update_slice(cache.k_scale, ksc, (0, 0, 0, 0, 0)),
+            lax.dynamic_update_slice(cache.v_scale, vsc, (0, 0, 0, 0, 0)))
     new_k = lax.dynamic_update_slice(cache.k, ks, (0, 0, 0, 0, 0))
     new_v = lax.dynamic_update_slice(cache.v, vs, (0, 0, 0, 0, 0))
     return logits, KVCache(new_k, new_v, lengths)
@@ -127,7 +172,13 @@ def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
 
     x = params["embed"]["tokens"].astype(cfg.dtype)[token[:, None]]  # (B,1,D)
 
+    int8_kv = cfg.kv_cache_dtype == "int8"
     if cfg.decode_attention_impl == "pallas":
+        if int8_kv:
+            raise ValueError(
+                "kv_cache_dtype='int8' requires decode_attention_impl="
+                "'xla' (the pallas decode kernel reads the cache dtype "
+                "directly)")
         from cloud_server_tpu.ops.decode_attention import decode_attention
 
         def attend(q, k_cache, v_cache):
@@ -149,20 +200,34 @@ def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
     # per-step cache traffic is just the (B, 1, KH, Dh) writes plus the
     # attention reads.
     k_all, v_all = cache.k, cache.v
+    ks_all, vs_all = cache.k_scale, cache.v_scale
     batch_idx = jnp.arange(token.shape[0])
     for layer_idx in range(cfg.num_layers):
         lp = jax.tree.map(lambda w: w[layer_idx], params["layers"])
         q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin, positions)
         # scatter the new (B, KH, Dh) entries straight into the stacked
         # cache — no read-modify-write of the whole 32MB layer slice
-        k_all = k_all.at[layer_idx, batch_idx, pos].set(k[:, 0])
-        v_all = v_all.at[layer_idx, batch_idx, pos].set(v[:, 0])
-        o = attend(q, k_all[layer_idx], v_all[layer_idx])
+        if int8_kv:
+            kq, ksc = _kv_quant(k[:, 0])
+            vq, vsc = _kv_quant(v[:, 0])
+            k_all = k_all.at[layer_idx, batch_idx, pos].set(kq)
+            v_all = v_all.at[layer_idx, batch_idx, pos].set(vq)
+            ks_all = ks_all.at[layer_idx, batch_idx, pos].set(ksc)
+            vs_all = vs_all.at[layer_idx, batch_idx, pos].set(vsc)
+            k_lay = _kv_dequant(k_all[layer_idx], ks_all[layer_idx],
+                                cfg.dtype)
+            v_lay = _kv_dequant(v_all[layer_idx], vs_all[layer_idx],
+                                cfg.dtype)
+        else:
+            k_all = k_all.at[layer_idx, batch_idx, pos].set(k[:, 0])
+            v_all = v_all.at[layer_idx, batch_idx, pos].set(v[:, 0])
+            k_lay, v_lay = k_all[layer_idx], v_all[layer_idx]
+        o = attend(q, k_lay, v_lay)
         x = transformer.attention_out(x, o, lp, cfg)
         x = _mlp_apply(x, lp, cfg)
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
     logits = transformer.unembed(x[:, 0], params, cfg)
-    return logits, KVCache(k_all, v_all, cache.length + 1)
+    return logits, KVCache(k_all, v_all, cache.length + 1, ks_all, vs_all)
 
 
 def verify_step(params, tokens: jnp.ndarray, cfg: ModelConfig,
@@ -187,22 +252,37 @@ def verify_step(params, tokens: jnp.ndarray, cfg: ModelConfig,
     pos = cache.length[:, None] + jnp.arange(kk)[None, :]  # (B, K)
 
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]  # (B, K, D)
+    int8_kv = cfg.kv_cache_dtype == "int8"
     k_all, v_all = cache.k, cache.v
+    ks_all, vs_all = cache.k_scale, cache.v_scale
     batch_idx = jnp.arange(b)
     for layer_idx in range(cfg.num_layers):
         lp = jax.tree.map(lambda w: w[layer_idx], params["layers"])
         q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin, pos)
-        k_all = k_all.at[layer_idx, batch_idx[:, None], pos].set(k)
-        v_all = v_all.at[layer_idx, batch_idx[:, None], pos].set(v)
+        if int8_kv:
+            kq, ksc = _kv_quant(k)
+            vq, vsc = _kv_quant(v)
+            k_all = k_all.at[layer_idx, batch_idx[:, None], pos].set(kq)
+            v_all = v_all.at[layer_idx, batch_idx[:, None], pos].set(vq)
+            ks_all = ks_all.at[layer_idx, batch_idx[:, None], pos].set(ksc)
+            vs_all = vs_all.at[layer_idx, batch_idx[:, None], pos].set(vsc)
+            k_lay = _kv_dequant(k_all[layer_idx], ks_all[layer_idx],
+                                cfg.dtype)
+            v_lay = _kv_dequant(v_all[layer_idx], vs_all[layer_idx],
+                                cfg.dtype)
+        else:
+            k_all = k_all.at[layer_idx, batch_idx[:, None], pos].set(k)
+            v_all = v_all.at[layer_idx, batch_idx[:, None], pos].set(v)
+            k_lay, v_lay = k_all[layer_idx], v_all[layer_idx]
         # q_positions give the in-window causal structure; kv_length masks
         # both stale cache entries and the other sequences' longer windows.
-        o = causal_attention(q, k_all[layer_idx], v_all[layer_idx],
+        o = causal_attention(q, k_lay, v_lay,
                              q_positions=pos, kv_length=cache.length + kk)
         x = transformer.attention_out(x, o, lp, cfg)
         x = _mlp_apply(x, lp, cfg)
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
     logits = transformer.unembed(x, params, cfg)  # (B, K, V)
-    return logits, KVCache(k_all, v_all, cache.length)
+    return logits, KVCache(k_all, v_all, cache.length, ks_all, vs_all)
 
 
 # ---------------------------------------------------------------------------

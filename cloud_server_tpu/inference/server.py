@@ -55,16 +55,19 @@ from cloud_server_tpu.inference.sampling import sample_logits
 class SlotState:
     """Device-resident server state (a pytree)."""
 
-    def __init__(self, k, v, length, last_token, active):
+    def __init__(self, k, v, length, last_token, active,
+                 k_scale=None, v_scale=None):
         self.k = k                    # (L, B, max_len, KH, Dh)
         self.v = v
         self.length = length          # (B,) int32
         self.last_token = last_token  # (B,) int32
         self.active = active          # (B,) bool
+        self.k_scale = k_scale        # int8 kv cache only, else None
+        self.v_scale = v_scale
 
     def tree_flatten(self):
         return (self.k, self.v, self.length, self.last_token,
-                self.active), None
+                self.active, self.k_scale, self.v_scale), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -81,7 +84,8 @@ def init_slot_state(cfg: ModelConfig, max_slots: int,
     return SlotState(
         k=cache.k, v=cache.v, length=cache.length,
         last_token=jnp.zeros((max_slots,), jnp.int32),
-        active=jnp.zeros((max_slots,), bool))
+        active=jnp.zeros((max_slots,), bool),
+        k_scale=cache.k_scale, v_scale=cache.v_scale)
 
 
 @partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
@@ -106,23 +110,32 @@ def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
 
     k = state.k.at[:, slots, :pb].set(tmp.k, mode="drop")
     v = state.v.at[:, slots, :pb].set(tmp.v, mode="drop")
+    k_scale = v_scale = None
+    if state.k_scale is not None:
+        k_scale = state.k_scale.at[:, slots, :pb].set(tmp.k_scale,
+                                                      mode="drop")
+        v_scale = state.v_scale.at[:, slots, :pb].set(tmp.v_scale,
+                                                      mode="drop")
     return SlotState(
         k=k, v=v,
         length=state.length.at[slots].set(true_lens, mode="drop"),
         last_token=state.last_token.at[slots].set(toks, mode="drop"),
-        active=state.active.at[slots].set(True, mode="drop")), toks
+        active=state.active.at[slots].set(True, mode="drop"),
+        k_scale=k_scale, v_scale=v_scale), toks
 
 
 def _decode_core(params, state: SlotState, rng: jax.Array,
                  cfg: ModelConfig, infer_cfg: InferConfig):
     """One decode step over all slots; inactive slots are frozen."""
-    cache = engine.KVCache(state.k, state.v, state.length)
+    cache = engine.KVCache(state.k, state.v, state.length,
+                           state.k_scale, state.v_scale)
     logits, cache = engine.decode_step(params, state.last_token, cfg, cache)
     tok = sample_logits(logits, rng, infer_cfg)
     tok = jnp.where(state.active, tok, infer_cfg.pad_token_id)
     length = jnp.where(state.active, cache.length, state.length)
     return SlotState(k=cache.k, v=cache.v, length=length, last_token=tok,
-                     active=state.active), tok
+                     active=state.active, k_scale=cache.k_scale,
+                     v_scale=cache.v_scale), tok
 
 
 @partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
@@ -157,7 +170,8 @@ def _decode_chunk(params, state: SlotState, rng: jax.Array, *,
 def _deactivate(state: SlotState, slot: jnp.ndarray) -> SlotState:
     return SlotState(k=state.k, v=state.v, length=state.length,
                      last_token=state.last_token,
-                     active=state.active.at[slot].set(False))
+                     active=state.active.at[slot].set(False),
+                     k_scale=state.k_scale, v_scale=state.v_scale)
 
 
 @dataclasses.dataclass

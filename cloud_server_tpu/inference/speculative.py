@@ -43,7 +43,7 @@ from jax import lax
 
 from cloud_server_tpu.config import InferConfig, ModelConfig
 from cloud_server_tpu.inference.engine import (
-    KVCache, decode_step, init_cache, prefill, verify_step)
+    decode_step, init_cache, prefill, verify_step)
 from cloud_server_tpu.inference.sampling import (
     sample_from_probs, sampling_probs)
 
@@ -173,8 +173,8 @@ def speculative_generate(params, draft_params, prompt: jnp.ndarray,
         out2 = out.at[batch_idx[:, None], cols].set(emit, mode="drop")
 
         new_len = cache.length + count
-        cache3 = KVCache(cache2.k, cache2.v, new_len)
-        d_cache3 = KVCache(d_cache2.k, d_cache2.v, new_len)
+        cache3 = cache2._replace(length=new_len)
+        d_cache3 = d_cache2._replace(length=new_len)
         done2 = done | (has_eos & (first_eos < count))
         n_emit2 = n_emit + count
         last_idx = jnp.maximum(count - 1, 0)
