@@ -174,7 +174,8 @@ def serving_bench():
     weights = {"bf16": params_bf16, "int8": params_int8}
     modes = [(impl, wname, "model") for impl in ("xla", "pallas")
              for wname in ("bf16", "int8")]
-    modes.append(("xla", "bf16", "int8"))  # int8 KV cache
+    modes.append(("xla", "bf16", "int8"))     # int8 KV, dequant outside
+    modes.append(("pallas", "bf16", "int8"))  # int8 KV, dequant in VMEM
     out = {}
     for impl, wname, kv in modes:
         cfg = dataclasses.replace(base, decode_attention_impl=impl,

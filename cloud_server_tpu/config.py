@@ -44,8 +44,8 @@ class ModelConfig:
     # decode-time (cached, single-query) attention: "xla" | "pallas"
     decode_attention_impl: str = "xla"
     # KV-cache storage: "model" (cfg.dtype) | "int8" (symmetric per-head
-    # absmax quantization — halves cache bytes/decode bandwidth at long
-    # context; xla decode path only)
+    # absmax quantization — halves cache memory; works with both decode
+    # impls: "xla" dequantizes outside attention, "pallas" in VMEM)
     kv_cache_dtype: str = "model"
     # mixture of experts (0 experts => dense MLP)
     num_experts: int = 0

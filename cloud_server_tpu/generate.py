@@ -48,8 +48,8 @@ def build_parser() -> argparse.ArgumentParser:
                    "the weight bytes streamed per decode step)")
     p.add_argument("--kv-cache-int8", action="store_true",
                    help="store the KV cache int8-quantized (halves cache "
-                   "bytes/decode bandwidth at long context; xla decode "
-                   "path)")
+                   "memory; pair with decode_attention_impl='pallas' for "
+                   "in-VMEM dequant)")
     p.add_argument("--ema", action="store_true",
                    help="serve the EMA-averaged weights from a checkpoint "
                    "trained with ema_decay > 0 (reads the checkpoint's "
@@ -131,10 +131,6 @@ def main(argv=None) -> None:
         model_cfg = from_json(ModelConfig, raw.get("model", {}))
     if args.kv_cache_int8:
         import dataclasses
-        if model_cfg.decode_attention_impl == "pallas":
-            raise SystemExit(
-                "--kv-cache-int8 requires decode_attention_impl='xla' "
-                "(the pallas decode kernel reads the cache dtype directly)")
         model_cfg = dataclasses.replace(model_cfg, kv_cache_dtype="int8")
     tok = get_tokenizer(args.tokenizer)
     if tok.vocab_size > model_cfg.vocab_size:

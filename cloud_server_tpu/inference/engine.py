@@ -174,17 +174,20 @@ def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
 
     int8_kv = cfg.kv_cache_dtype == "int8"
     if cfg.decode_attention_impl == "pallas":
-        if int8_kv:
-            raise ValueError(
-                "kv_cache_dtype='int8' requires decode_attention_impl="
-                "'xla' (the pallas decode kernel reads the cache dtype "
-                "directly)")
         from cloud_server_tpu.ops.decode_attention import decode_attention
 
-        def attend(q, k_cache, v_cache):
-            return decode_attention(q, k_cache, v_cache, cache.length + 1)
+        # int8 caches go to the kernel RAW with their scales — dequant
+        # happens in VMEM, so decode streams half the HBM bytes. (The XLA
+        # path below dequantizes outside attention, which materialises a
+        # per-layer copy; pallas is the fast int8 path.)
+        def attend(q, k_cache, v_cache, k_scale=None, v_scale=None):
+            return decode_attention(q, k_cache, v_cache, cache.length + 1,
+                                    k_scale=k_scale, v_scale=v_scale)
     elif cfg.decode_attention_impl == "xla":
-        def attend(q, k_cache, v_cache):
+        def attend(q, k_cache, v_cache, k_scale=None, v_scale=None):
+            if k_scale is not None:
+                k_cache = _kv_dequant(k_cache, k_scale, cfg.dtype)
+                v_cache = _kv_dequant(v_cache, v_scale, cfg.dtype)
             return causal_attention(q, k_cache, v_cache,
                                     q_positions=positions,
                                     kv_length=cache.length + 1)
@@ -214,15 +217,12 @@ def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
             v_all = v_all.at[layer_idx, batch_idx, pos].set(vq)
             ks_all = ks_all.at[layer_idx, batch_idx, pos].set(ksc)
             vs_all = vs_all.at[layer_idx, batch_idx, pos].set(vsc)
-            k_lay = _kv_dequant(k_all[layer_idx], ks_all[layer_idx],
-                                cfg.dtype)
-            v_lay = _kv_dequant(v_all[layer_idx], vs_all[layer_idx],
-                                cfg.dtype)
+            o = attend(q, k_all[layer_idx], v_all[layer_idx],
+                       ks_all[layer_idx], vs_all[layer_idx])
         else:
             k_all = k_all.at[layer_idx, batch_idx, pos].set(k[:, 0])
             v_all = v_all.at[layer_idx, batch_idx, pos].set(v[:, 0])
-            k_lay, v_lay = k_all[layer_idx], v_all[layer_idx]
-        o = attend(q, k_lay, v_lay)
+            o = attend(q, k_all[layer_idx], v_all[layer_idx])
         x = transformer.attention_out(x, o, lp, cfg)
         x = _mlp_apply(x, lp, cfg)
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)

@@ -19,14 +19,14 @@ flash kernel (`ops/flash_attention.py`); parity with the XLA reference
 (`ops/attention.py::causal_attention`) is tested to 2e-2 in bf16 and 2e-5
 in f32.
 
-When to prefer this over the XLA path (measured on TPU v5e, 2026-07):
-with many kv heads (MHA-style, e.g. KH=16, Dh=64) the per-block VMEM cap
-shrinks block_s and XLA's fused batched matmul wins (~25% faster at the
-B=8, S=1024 serving shape — see bench.py decode extras); with few kv
-heads (GQA, KH<=4) blocks stay large and this kernel matches or beats
-XLA, increasingly so at long context. Serving configs keep
-`decode_attention_impl="xla"` for MHA checkpoints and "pallas" for
-strongly-GQA ones.
+Measured reality check (TPU v5e, 2026-07, steady-state serving bench —
+not dispatch-skewed microbenches): XLA's fused batched matmul beats this
+kernel at every shape tried — ~25% faster at B=8/S=1024/KH=16, ~3x at
+S=8192 (both MHA KH=16 and GQA KH=4, bf16 and int8 caches). The
+per-(batch, kv-block) grid with an unrolled kv-head loop doesn't
+pipeline the big cache DMAs as well as XLA's schedule. The kernel stays
+as the in-VMEM int8-dequant path and a base for future tuning, but
+`decode_attention_impl="xla"` is the recommended default everywhere.
 
 Forward-only by design — decode never backprops.
 """
@@ -43,8 +43,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *, scale, block_s, kh, g):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *refs,
+                   scale, block_s, kh, g, int8_kv):
+    if int8_kv:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, acc_ref, m_ref, l_ref = refs
     # Grid is (batch, kv_blocks): the TPU lowering requires the last two
     # block dims to equal the array dims, so the (B, S, KH, Dh) cache can't
     # be blocked per kv head — instead each grid cell sees ALL kv heads and
@@ -75,6 +79,15 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
             q = q_ref[0, ki].astype(jnp.float32)       # (G, Dh)
             k = k_ref[0, :, ki].astype(jnp.float32)    # (block_s, Dh)
             v = jnp.where(v_valid, v_ref[0, :, ki], 0).astype(jnp.float32)
+            if int8_kv:
+                # dequantize in VMEM: the int8 cache is the only HBM
+                # traffic (the whole point — see engine._kv_quant).
+                # vs must be masked like v: the zeroed invalid v rows
+                # times NaN/Inf scale garbage in a pallas-padded boundary
+                # block would be NaN again (k needs no mask — its scores
+                # are NEG_INF-masked after the dot).
+                k = k * ks_ref[0, :, ki]               # (block_s, 1) bcast
+                v = v * jnp.where(v_valid, vs_ref[0, :, ki], 0.0)
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # (G, block_s)
@@ -117,15 +130,20 @@ def _default_block(seq: int, want: int, kh: int, d: int,
 
 
 def decode_attention(q, k_cache, v_cache, lengths, *, scale=None,
-                     block_s: int = 512, interpret: bool | None = None):
+                     block_s: int = 512, interpret: bool | None = None,
+                     k_scale=None, v_scale=None):
     """Single-position attention against a ragged cache.
 
     Args:
       q: (B, 1, H, Dh) — the current decode position's queries (sequence i
         sits at absolute position lengths[i] - 1 after its cache write).
       k_cache, v_cache: (B, S, KH, Dh), entries at [s >= lengths[i]] stale.
+        May be int8 (engine._kv_quant layout) when k_scale/v_scale are
+        given — dequantization then happens in VMEM, so the int8 cache is
+        the only HBM traffic (half the bytes of the bf16 cache).
       lengths: (B,) int32 — number of VALID cache entries (i.e. the
         post-write kv_length the XLA path receives).
+      k_scale, v_scale: optional (B, S, KH, 1) f32 absmax scales.
 
     Returns (B, 1, H, Dh) in q.dtype. Equivalent to
     `causal_attention(q, k, v, q_positions=lengths[:,None]-1,
@@ -135,24 +153,40 @@ def decode_attention(q, k_cache, v_cache, lengths, *, scale=None,
     assert one == 1, f"decode takes one query per sequence, got Sq={one}"
     _, s, kh, _ = k_cache.shape
     g = h // kh
+    int8_kv = k_scale is not None
     if scale is None:
         scale = d**-0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    block_s = _default_block(s, block_s, kh, d, k_cache.dtype.itemsize)
+    # int8 caches stage smaller HBM blocks but dequantize to f32 inside
+    # the kernel, so the VMEM working set per row is ~4B/element across
+    # the unrolled kv-head loop's temporaries — size blocks by that, not
+    # by the storage itemsize (measured: itemsize-1 AND itemsize-2 block
+    # budgets both blow the 16MB scoped-vmem limit at KH=16, Dh=64;
+    # effective 4B compiles with headroom).
+    eff_itemsize = 4 if int8_kv else k_cache.dtype.itemsize
+    block_s = _default_block(s, block_s, kh, d, eff_itemsize)
 
     qg = q.reshape(b, kh, g, d)
     grid = (b, pl.cdiv(s, block_s))
+    kv_spec = pl.BlockSpec((1, block_s, kh, d), lambda bi, j: (bi, j, 0, 0))
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths, whole array
+        pl.BlockSpec((1, kh, g, d), lambda bi, j: (bi, 0, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    inputs = [lengths.astype(jnp.int32), qg, k_cache, v_cache]
+    if int8_kv:
+        scale_spec = pl.BlockSpec((1, block_s, kh, 1),
+                                  lambda bi, j: (bi, j, 0, 0))
+        in_specs.extend([scale_spec, scale_spec])
+        inputs.extend([k_scale, v_scale])
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=scale, block_s=block_s,
-                          kh=kh, g=g),
+                          kh=kh, g=g, int8_kv=int8_kv),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths, whole array
-            pl.BlockSpec((1, kh, g, d), lambda bi, j: (bi, 0, 0, 0)),
-            pl.BlockSpec((1, block_s, kh, d), lambda bi, j: (bi, j, 0, 0)),
-            pl.BlockSpec((1, block_s, kh, d), lambda bi, j: (bi, j, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, kh, g, d), lambda bi, j: (bi, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, kh, g, d), q.dtype),
         scratch_shapes=[
@@ -161,5 +195,5 @@ def decode_attention(q, k_cache, v_cache, lengths, *, scale=None,
             pltpu.VMEM((kh * g, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(lengths.astype(jnp.int32), qg, k_cache, v_cache)
+    )(*inputs)
     return out.reshape(b, 1, h, d)

@@ -85,6 +85,26 @@ def test_engine_generate_parity():
     np.testing.assert_array_equal(np.asarray(out_xla), np.asarray(out_pallas))
 
 
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="compiled-mode Mosaic lowering needs a real TPU")
+def test_int8_compiled_on_tpu():
+    """The int8-dequant variant must lower and match on-chip (its block
+    budget is tighter: effective 4B/element or scoped-vmem OOMs)."""
+    from cloud_server_tpu.inference.engine import _kv_quant
+
+    q, k, v, lengths = _case(b=4, s=1024, h=16, kh=16, d=64,
+                             dtype=jnp.bfloat16)
+    k8, ks = _kv_quant(k)
+    v8, vs = _kv_quant(v)
+    got = jax.jit(lambda: decode_attention(
+        q, k8, v8, lengths, k_scale=ks, v_scale=vs))()
+    want = _reference(q, (k8.astype(jnp.float32) * ks).astype(jnp.bfloat16),
+                      (v8.astype(jnp.float32) * vs).astype(jnp.bfloat16),
+                      lengths)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), atol=3e-2)
+
+
 def test_rejects_multi_query():
     q, k, v, lengths = _case()
     with pytest.raises(AssertionError):
