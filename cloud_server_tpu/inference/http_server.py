@@ -8,10 +8,13 @@ the jitted TPU program; this module only does sockets and JSON.
 Protocol:
   POST /generate    {"prompt": "text"} or {"tokens": [1, 2, 3]},
                     optional "max_new_tokens". Response is
-                    `application/x-ndjson`: one {"token": id, "text": s}
+                    `application/x-ndjson`: one {"token": id,
+                    "logprob": lp, "text": s}
                     line per generated token (text only when a tokenizer is
                     attached), then a final
-                    {"done": true, "finish_reason": ..., "tokens": [...]}.
+                    {"done": true, "finish_reason": ...,
+                    "tokens": [...], "logprobs": [...]} (logprobs aligned
+                    with tokens).
   GET  /healthz     {"ok": true, "active": N, "pending": N}
 
 Demo (server side: `python -m cloud_server_tpu.generate --serve-http 8000
@@ -106,18 +109,25 @@ class HttpFrontend:
                     target=lambda: (request._done.wait(),
                                     q.put(_STREAM_END)),
                     daemon=True).start()
+                emitted = 0
                 while True:
                     tok = q.get()
                     if tok is _STREAM_END:
                         break
                     line = {"token": int(tok)}
+                    # _emit appends the logprob before invoking the stream
+                    # callback, so it is present by the time we get here
+                    if emitted < len(request.logprobs):
+                        line["logprob"] = request.logprobs[emitted]
+                    emitted += 1
                     if front.tokenizer is not None:
                         line["text"] = front.tokenizer.decode([int(tok)])
                     self.wfile.write((json.dumps(line) + "\n").encode())
                     self.wfile.flush()
                 self.wfile.write((json.dumps(
                     {"done": True, "finish_reason": request.finish_reason,
-                     "tokens": request.tokens}) + "\n").encode())
+                     "tokens": request.tokens,
+                     "logprobs": request.logprobs}) + "\n").encode())
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None

@@ -52,6 +52,13 @@ from cloud_server_tpu.inference import engine
 from cloud_server_tpu.inference.sampling import sample_logits
 
 
+def _token_logprobs(logits: jnp.ndarray, toks: jnp.ndarray) -> jnp.ndarray:
+    """log P(tok) under the model's raw (pre-filter) distribution — the
+    one serving-API logprob convention, shared by admission and decode."""
+    return jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                               toks[:, None], axis=-1)[:, 0]
+
+
 class SlotState:
     """Device-resident server state (a pytree)."""
 
@@ -101,12 +108,13 @@ def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
     traced, so slot choice never recompiles; only (G, Pb) does (both are
     bucketed by the caller).
 
-    Returns (state', first_tokens (G,)).
+    Returns (state', first_tokens (G,), their logprobs (G,) f32).
     """
     g, pb = prompts.shape
     tmp = engine.init_cache(cfg, g, pb)
     logits, tmp = engine.prefill(params, prompts, cfg, tmp, true_lens)
     toks = sample_logits(logits, rng, infer_cfg)  # (G,)
+    lps = _token_logprobs(logits, toks)  # (G,)
 
     k = state.k.at[:, slots, :pb].set(tmp.k, mode="drop")
     v = state.v.at[:, slots, :pb].set(tmp.v, mode="drop")
@@ -121,7 +129,7 @@ def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
         length=state.length.at[slots].set(true_lens, mode="drop"),
         last_token=state.last_token.at[slots].set(toks, mode="drop"),
         active=state.active.at[slots].set(True, mode="drop"),
-        k_scale=k_scale, v_scale=v_scale), toks
+        k_scale=k_scale, v_scale=v_scale), toks, lps
 
 
 def _decode_core(params, state: SlotState, rng: jax.Array,
@@ -131,17 +139,19 @@ def _decode_core(params, state: SlotState, rng: jax.Array,
                            state.k_scale, state.v_scale)
     logits, cache = engine.decode_step(params, state.last_token, cfg, cache)
     tok = sample_logits(logits, rng, infer_cfg)
+    lp = _token_logprobs(logits, tok)
     tok = jnp.where(state.active, tok, infer_cfg.pad_token_id)
     length = jnp.where(state.active, cache.length, state.length)
     return SlotState(k=cache.k, v=cache.v, length=length, last_token=tok,
                      active=state.active, k_scale=cache.k_scale,
-                     v_scale=cache.v_scale), tok
+                     v_scale=cache.v_scale), (tok, lp)
 
 
 @partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
 def _decode(params, state: SlotState, rng: jax.Array, *, cfg: ModelConfig,
             infer_cfg: InferConfig):
-    """Returns (state', tokens (B,) int32) with pad in inactive rows."""
+    """Returns (state', (tokens (B,) int32, logprobs (B,) f32)) with pad
+    in inactive rows."""
     return _decode_core(params, state, rng, cfg, infer_cfg)
 
 
@@ -158,7 +168,8 @@ def _decode_chunk(params, state: SlotState, rng: jax.Array, *,
     trades at most n_steps - 1 wasted decode steps (and that much admission
     latency) for steady-state throughput.
 
-    Returns (state', tokens (n_steps, B) int32).
+    Returns (state', (tokens (n_steps, B) int32,
+    logprobs (n_steps, B) f32)).
     """
     def body(st, r):
         return _decode_core(params, st, r, cfg, infer_cfg)
@@ -182,6 +193,9 @@ class Request:
     max_new_tokens: int
     stream: Callable[[int], None] | None = None
     tokens: list[int] = dataclasses.field(default_factory=list)
+    # log P(token) under the model's raw (pre-filter) distribution,
+    # aligned with `tokens`
+    logprobs: list[float] = dataclasses.field(default_factory=list)
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event)
     finish_reason: str | None = None  # "eos" | "length" | "error: ..."
@@ -308,12 +322,17 @@ class InferenceServer:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def _emit(self, req: Request, token: int) -> bool:
+    def _emit(self, req: Request, token: int,
+              logprob: float | None = None) -> bool:
         """Record one generated token; True if the request just finished."""
         if token == self.infer_cfg.eos_token_id:
             req.finish_reason = "eos"
             return True
         req.tokens.append(token)
+        if logprob is not None:
+            # append before stream(): a consumer woken by the stream
+            # callback may read logprobs[len(tokens)-1]
+            req.logprobs.append(float(logprob))
         if req.stream is not None:
             req.stream(token)
         if len(req.tokens) >= req.max_new_tokens:
@@ -362,13 +381,13 @@ class InferenceServer:
             prompts[i, :len(req.prompt)] = req.prompt
             true_lens[i] = len(req.prompt)
             slots[i] = slot
-        self.state, toks = _admit_batch(
+        self.state, toks, lps = _admit_batch(
             self.params, self.state, jnp.asarray(prompts),
             jnp.asarray(true_lens), jnp.asarray(slots),
             self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg)
-        toks = np.asarray(jax.device_get(toks))
+        toks, lps = jax.device_get((toks, lps))
         for i, (slot, req) in enumerate(group):
-            if self._emit(req, int(toks[i])):
+            if self._emit(req, int(toks[i]), float(lps[i])):
                 self._finish(slot, req)
 
     @property
@@ -405,18 +424,24 @@ class InferenceServer:
                 return 0
             n = self._chunk_len()
             if n == 1:
-                self.state, toks = _decode(
+                self.state, out = _decode(
                     self.params, self.state, self._next_rng(),
                     cfg=self.cfg, infer_cfg=self.infer_cfg)
-                chunk = np.asarray(jax.device_get(toks))[None]  # (1, B)
+                toks, lps = jax.device_get(out)
+                chunk = np.asarray(toks)[None]       # (1, B)
+                lchunk = np.asarray(lps)[None]
             else:
-                self.state, toks = _decode_chunk(
+                self.state, out = _decode_chunk(
                     self.params, self.state, self._next_rng(),
                     cfg=self.cfg, infer_cfg=self.infer_cfg, n_steps=n)
-                chunk = np.asarray(jax.device_get(toks))  # (n, B)
+                toks, lps = jax.device_get(out)
+                chunk = np.asarray(toks)             # (n, B)
+                lchunk = np.asarray(lps)
             for t in range(chunk.shape[0]):
                 for slot, req in enumerate(self._slots):
-                    if req is not None and self._emit(req, int(chunk[t, slot])):
+                    if req is not None and self._emit(
+                            req, int(chunk[t, slot]),
+                            float(lchunk[t, slot])):
                         self._finish(slot, req)
             return self.num_active
 

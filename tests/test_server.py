@@ -115,9 +115,12 @@ def test_scheduler_error_unblocks_clients(params):
     srv = InferenceServer(params, CFG, GREEDY, max_slots=1, max_len=64,
                           prompt_buckets=[16])
     srv.step = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    # submit BEFORE start: the patched step() raises on the scheduler's
+    # first iteration, and a post-crash submit would (correctly) be
+    # rejected with "server is stopped" — a race this test isn't about
+    req = srv.submit(PROMPTS[0], max_new_tokens=4)
     srv.start()
     try:
-        req = srv.submit(PROMPTS[0], max_new_tokens=4)
         with pytest.raises(RuntimeError, match="boom"):
             req.result(timeout=60)
     finally:
@@ -199,3 +202,29 @@ def test_decode_chunk_respects_eos(params):
     assert r0.result() == ref[:idx]
     assert r0.finish_reason == "eos"
     assert r1.done
+
+
+def test_logprobs_recorded(devices8):
+    """Every emitted token carries the log-probability the model assigned
+    it; greedy tokens must have the max logprob over the vocab."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cloud_server_tpu.inference.engine import init_cache, prefill
+    from cloud_server_tpu.models import transformer
+
+    params = transformer.init_params(CFG, jax.random.key(0))
+    icfg = InferConfig(max_decode_len=6, temperature=0.0, eos_token_id=-1,
+                       pad_token_id=0)
+    srv = InferenceServer(params, CFG, icfg, max_slots=2, max_len=32)
+    req = srv.submit([3, 7, 11], max_new_tokens=6)
+    srv.run_until_idle()
+    assert len(req.logprobs) == len(req.tokens) == 6
+    assert all(lp <= 0.0 for lp in req.logprobs)
+    # check the FIRST token's logprob against a hand prefill
+    cache = init_cache(CFG, 1, 32)
+    logits, _ = prefill(params, jnp.asarray([[3, 7, 11]], jnp.int32),
+                        CFG, cache)
+    want = float(jax.nn.log_softmax(logits[0])[req.tokens[0]])
+    np.testing.assert_allclose(req.logprobs[0], want, rtol=1e-4)
