@@ -71,6 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
                    "useful for smoke tests)")
     p.add_argument("--num-draft", type=int, default=4,
                    help="draft tokens proposed per speculative round")
+    p.add_argument("--ngram-draft", action="store_true",
+                   help="speculative decoding WITHOUT a draft model: "
+                   "propose continuations of repeated n-grams from the "
+                   "sequence so far (exact output; wins on repetitive "
+                   "text); batch mode only")
     from cloud_server_tpu.models.lora import add_lora_args
     add_lora_args(p)
     return p
@@ -208,10 +213,10 @@ def main(argv=None) -> None:
         pad_token_id=tok.pad_id or 0)
 
     if args.serve_http is not None:
-        if args.draft_config:
+        if args.draft_config or args.ngram_draft:
             raise SystemExit(
-                "--draft-config is batch-mode only; --serve-http would "
-                "silently serve without speculation")
+                "--draft-config/--ngram-draft are batch-mode only; "
+                "--serve-http would silently serve without speculation")
         from cloud_server_tpu.inference.http_server import HttpFrontend
         max_len = args.max_len or model_cfg.max_seq_len
         srv = InferenceServer(params, model_cfg, infer_cfg, max_slots=8,
@@ -235,22 +240,29 @@ def main(argv=None) -> None:
 
     encoded = [tok.encode(p, add_bos=args.add_bos and tok.bos_id is not None)
                or [0] for p in prompts]
-    if args.draft_config:
+    if args.draft_config or args.ngram_draft:
         import jax
         import numpy as np
 
         from cloud_server_tpu.inference.speculative import (
             speculative_generate)
-        with open(args.draft_config) as f:
-            draft_cfg = from_json(ModelConfig, json.load(f).get("model", {}))
         if args.quantize:
-            raise SystemExit("--quantize + --draft-config not supported yet")
-        draft_module = None
-        if draft_cfg.num_experts >= 2:
-            from cloud_server_tpu.models import moe as draft_module
-        draft_params = load_params(draft_cfg, args.draft_checkpoint_dir,
-                                   None, args.seed + 1,
-                                   loss_fn_module=draft_module)
+            raise SystemExit("--quantize + speculative decoding not "
+                             "supported yet")
+        if args.draft_config and args.ngram_draft:
+            raise SystemExit("--draft-config and --ngram-draft are "
+                             "mutually exclusive draft sources")
+        draft_cfg = draft_params = None
+        if args.draft_config:
+            with open(args.draft_config) as f:
+                draft_cfg = from_json(ModelConfig,
+                                      json.load(f).get("model", {}))
+            draft_module = None
+            if draft_cfg.num_experts >= 2:
+                from cloud_server_tpu.models import moe as draft_module
+            draft_params = load_params(draft_cfg, args.draft_checkpoint_dir,
+                                       None, args.seed + 1,
+                                       loss_fn_module=draft_module)
         longest = max(len(e) for e in encoded)
         # honour --max-len / the trained context window like the plain
         # path: the cache must hold prompt + new tokens + the speculative

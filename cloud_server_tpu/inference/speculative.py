@@ -1,31 +1,43 @@
-"""Speculative decoding: a small draft model proposes G tokens per round,
-the target model scores the whole window in one `verify_step` pass, and a
-rejection-sampling rule commits an accepted prefix plus one corrective
-token.
+"""Speculative decoding: propose G tokens per round, score the whole
+window in one `verify_step` pass, commit an accepted prefix plus one
+corrective token.
 
-Output-distribution exactness: acceptance follows the standard
-speculative-sampling rule — draft token d with draft probability q(d) and
-target probability p(d) is accepted with prob min(1, p(d)/q(d)); on first
-rejection the corrective token is drawn from normalize(max(p - q, 0)); if
-all G drafts survive, a bonus token is drawn from the target's distribution
-at the window's last position. Both p and q are the *post-filter* sampling
-distributions (`sampling.sampling_probs`), so temperature/top-k/top-p
-semantics match plain `generate`; at temperature 0 both collapse to
-one-hots and the rule reduces to exact-match greedy — speculative greedy
-output is identical to `generate`'s token-for-token.
+Two draft sources share one loop:
+  * a small DRAFT MODEL (classic speculative decoding) — pass
+    `draft_params`/`draft_cfg`;
+  * PROMPT-LOOKUP (n-gram) drafting — pass `draft_params=None`: proposals
+    are the tokens that followed the most recent earlier occurrence of
+    the current bigram in the sequence so far. No second model, no extra
+    memory; it wins on text with local repetition (code, structured
+    data, retrieval-heavy prompts) and degrades to plain decoding
+    (one committed token per round) when nothing matches.
+
+Output-distribution exactness holds for BOTH sources: acceptance follows
+the standard speculative-sampling rule — draft token d with draft
+probability q(d) and target probability p(d) is accepted with prob
+min(1, p(d)/q(d)); on first rejection the corrective token is drawn from
+normalize(max(p - q, 0)); if all G drafts survive, a bonus token is drawn
+from the target's distribution at the window's last position. For n-gram
+drafting q is a point mass at the proposal, so the rule reduces to
+"accept with prob p(d)" — still exact, whatever the proposals are. Both
+p and q are the *post-filter* sampling distributions
+(`sampling.sampling_probs`), so temperature/top-k/top-p semantics match
+plain `generate`; at temperature 0 the rule reduces to exact-match greedy
+and speculative output is identical to `generate`'s token-for-token.
 
 Why this is the right shape for TPU decode: decode is HBM-bound (the full
 weight set streams per token), so scoring G+1 positions in one pass costs
 barely more than scoring one. Wall-clock per committed token drops by
-roughly the mean accepted length; everything (draft scan, verify, accept,
-commit, output scatter) runs inside ONE jitted `lax.while_loop` with static
-shapes — no host round-trip per round.
+roughly the mean accepted length; everything (draft, verify, accept,
+commit, output scatter) runs inside ONE jitted `lax.while_loop` with
+static shapes — no host round-trip per round.
 
-Cache discipline — both models keep the invariant "at round start, every
-committed token EXCEPT the last has been processed into the cache":
-  * the draft runs G+1 decode steps — the last one exists only to process
-    its own G-th proposal so that when everything is accepted its cache is
-    already caught up; its sample is discarded.
+Cache discipline — the target (and the draft model, when present) keeps
+the invariant "at round start, every committed token EXCEPT the last has
+been processed into the cache":
+  * the draft model runs G+1 decode steps — the last one exists only to
+    process its own G-th proposal so that when everything is accepted its
+    cache is already caught up; its sample is discarded.
   * `verify_step` writes the window's kv entries but does not advance
     `length`; the commit just advances each sequence's length by the
     number of committed tokens. Stale entries past the commit point are
@@ -84,22 +96,82 @@ def _accept_drafts(drafts, q_probs, p_probs, rng):
     return n_acc, x
 
 
+def _accept_point_mass(drafts, p_probs, rng):
+    """`_accept_drafts` specialised to point-mass q (n-gram drafting):
+    q(d) = 1, so acceptance is `u < p(d)` and the residual is p with the
+    rejected proposal's index zeroed — computed directly, without
+    materialising the (B, G, V) one-hot q tensor in the hot decode loop.
+    """
+    b, g = drafts.shape
+    rng_u, rng_x = jax.random.split(rng)
+    batch_idx = jnp.arange(b)
+
+    p_d = jnp.take_along_axis(p_probs[:, :g], drafts[..., None],
+                              axis=-1)[..., 0]
+    u = jax.random.uniform(rng_u, (b, g))
+    prefix = jnp.cumprod((u < p_d).astype(jnp.int32), axis=-1)
+    n_acc = prefix.sum(axis=-1)
+
+    p_r = p_probs[batch_idx, n_acc]  # (B, V)
+    rejected = jnp.where(n_acc < g, drafts[batch_idx,
+                                           jnp.minimum(n_acc, g - 1)], -1)
+    residual = jnp.where(
+        (jnp.arange(p_r.shape[-1])[None, :] == rejected[:, None])
+        & (n_acc < g)[:, None], 0.0, p_r)
+    bad = residual.sum(-1, keepdims=True) <= 0.0
+    residual = jnp.where(bad, p_r, residual)
+    x = sample_from_probs(residual, rng_x)
+    return n_acc, x
+
+
+def _ngram_drafts(hist, valid, t_prev2, t_prev, g, pad):
+    """Prompt-lookup proposals: find the latest earlier occurrence of the
+    bigram (t_prev2, t_prev) in `hist[:, :valid]` and propose the G tokens
+    that followed it.
+
+    hist: (B, H) committed tokens (prompt + generated), pad beyond
+    `valid`; t_prev2/t_prev: the last two committed tokens. Positions
+    with no match (or running off the committed region) propose `pad` —
+    an ordinary (usually wrong) proposal the accept rule scores like any
+    other, so exactness is unaffected.
+    """
+    bsz, hl = hist.shape
+    i = jnp.arange(hl - 1)
+    match = ((hist[:, :-1] == t_prev2[:, None])
+             & (hist[:, 1:] == t_prev[:, None])
+             # strictly BEFORE the current occurrence at (valid-2, valid-1)
+             & (i[None, :] + 1 < (valid - 1)[:, None]))
+    last = jnp.max(jnp.where(match, i, -1), axis=1)  # (B,)
+    found = last >= 0
+    pos = (last + 2)[:, None] + jnp.arange(g)[None, :]  # (B, G)
+    ok = found[:, None] & (pos < valid[:, None])
+    gathered = jnp.take_along_axis(hist, jnp.clip(pos, 0, hl - 1), axis=1)
+    return jnp.where(ok, gathered, pad)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "draft_cfg", "infer_cfg", "num_draft",
                      "max_len"))
 def speculative_generate(params, draft_params, prompt: jnp.ndarray,
                          rng: jax.Array, *, cfg: ModelConfig,
-                         draft_cfg: ModelConfig, infer_cfg: InferConfig,
+                         draft_cfg: ModelConfig | None = None,
+                         infer_cfg: InferConfig,
                          num_draft: int = 4, max_len: int | None = None,
                          prompt_lengths: jnp.ndarray | None = None
                          ) -> jnp.ndarray:
     """Speculative counterpart of `engine.generate` — same contract:
     prompt (B, P) int32 right-padded (pass prompt_lengths when ragged),
-    returns (B, max_decode_len) int32 with pad after eos. The draft model
-    must share the target's tokenizer/vocab; `num_draft` (G) proposals are
-    scored per round.
+    returns (B, max_decode_len) int32 with pad after eos.
+
+    `draft_params`/`draft_cfg` select the draft source: a small model
+    sharing the target's tokenizer/vocab, or None/None for prompt-lookup
+    (n-gram) drafting. `num_draft` (G) proposals are scored per round.
     """
+    use_ngram = draft_params is None
+    if use_ngram != (draft_cfg is None):
+        raise ValueError("pass draft_params and draft_cfg together "
+                         "(both None selects n-gram drafting)")
     b, p = prompt.shape
     g = num_draft
     n_new = infer_cfg.max_decode_len
@@ -113,10 +185,15 @@ def speculative_generate(params, draft_params, prompt: jnp.ndarray,
 
     cache = init_cache(cfg, b, max_len)
     logits, cache = prefill(params, prompt, cfg, cache, prompt_lengths)
-    d_cache = init_cache(draft_cfg, b, max_len)
-    _, d_cache = prefill(draft_params, prompt, draft_cfg, d_cache,
-                         prompt_lengths)
+    if use_ngram:
+        d_cache = None
+    else:
+        d_cache = init_cache(draft_cfg, b, max_len)
+        _, d_cache = prefill(draft_params, prompt, draft_cfg, d_cache,
+                             prompt_lengths)
 
+    plen = (jnp.full((b,), p, jnp.int32) if prompt_lengths is None
+            else prompt_lengths.astype(jnp.int32))
     rng, rng0 = jax.random.split(rng)
     t_prev = sample_from_probs(sampling_probs(logits, infer_cfg), rng0)
     done0 = t_prev == infer_cfg.eos_token_id
@@ -128,30 +205,46 @@ def speculative_generate(params, draft_params, prompt: jnp.ndarray,
     batch_idx = jnp.arange(b)
     j = jnp.arange(g + 1)[None, :]  # (1, G+1)
 
+    # committed-token history (prompt + generated) for n-gram lookup
+    hist0 = jnp.full((b, p + n_new + g + 1), pad, jnp.int32)
+    hist0 = lax.dynamic_update_slice(hist0, prompt, (0, 0))
+    hist0 = hist0.at[batch_idx, plen].set(t_prev)
+    t_prev2_0 = hist0[batch_idx, jnp.maximum(plen - 1, 0)]
+
     def round_body(state):
-        rnd, rng, t_prev, done, n_emit, out, cache, d_cache = state
+        (rnd, rng, t_prev, t_prev2, done, n_emit, out, hist, cache,
+         d_cache) = state
         rng, r_draft, r_acc = jax.random.split(
             jax.random.fold_in(rng, rnd), 3)
 
-        # --- draft: G+1 decode steps (see module docstring) ---
-        def d_step(carry, rng_t):
-            tok, dc = carry
-            dlogits, dc = decode_step(draft_params, tok, draft_cfg, dc)
-            qp = sampling_probs(dlogits, infer_cfg)
-            nxt = sample_from_probs(qp, rng_t)
-            return (nxt, dc), (nxt, qp)
+        if use_ngram:
+            valid = plen + n_emit
+            drafts = _ngram_drafts(hist, valid, t_prev2, t_prev, g, pad)
+            q_probs = None  # point mass; _accept_point_mass handles it
+            d_cache2 = d_cache
+        else:
+            # --- draft model: G+1 decode steps (see module docstring) ---
+            def d_step(carry, rng_t):
+                tok, dc = carry
+                dlogits, dc = decode_step(draft_params, tok, draft_cfg, dc)
+                qp = sampling_probs(dlogits, infer_cfg)
+                nxt = sample_from_probs(qp, rng_t)
+                return (nxt, dc), (nxt, qp)
 
-        (_, d_cache2), (draft_toks, q_probs) = lax.scan(
-            d_step, (t_prev, d_cache), jax.random.split(r_draft, g + 1))
-        drafts = draft_toks[:g].T  # (B, G)
-        q_probs = q_probs[:g].transpose(1, 0, 2)  # (B, G, V)
+            (_, d_cache2), (draft_toks, q_probs) = lax.scan(
+                d_step, (t_prev, d_cache), jax.random.split(r_draft, g + 1))
+            drafts = draft_toks[:g].T  # (B, G)
+            q_probs = q_probs[:g].transpose(1, 0, 2)  # (B, G, V)
 
         # --- verify the whole window in one target pass ---
         window = jnp.concatenate([t_prev[:, None], drafts], axis=1)
         vlogits, cache2 = verify_step(params, window, cfg, cache)
         p_probs = sampling_probs(vlogits, infer_cfg)  # (B, G+1, V)
 
-        n_acc, x = _accept_drafts(drafts, q_probs, p_probs, r_acc)
+        if use_ngram:
+            n_acc, x = _accept_point_mass(drafts, p_probs, r_acc)
+        else:
+            n_acc, x = _accept_drafts(drafts, q_probs, p_probs, r_acc)
 
         # --- commit d_1..d_{n_acc} then x, truncated at the first eos ---
         drafts_x = jnp.concatenate([drafts, x[:, None]], axis=1)  # (B,G+1)
@@ -171,24 +264,29 @@ def speculative_generate(params, draft_params, prompt: jnp.ndarray,
         # the buffer drop.
         cols = n_emit[:, None] + j  # (B, G+1)
         out2 = out.at[batch_idx[:, None], cols].set(emit, mode="drop")
+        hist2 = hist.at[batch_idx[:, None],
+                        plen[:, None] + cols].set(emit, mode="drop")
 
         new_len = cache.length + count
         cache3 = cache2._replace(length=new_len)
-        d_cache3 = d_cache2._replace(length=new_len)
+        d_cache3 = (None if use_ngram
+                    else d_cache2._replace(length=new_len))
         done2 = done | (has_eos & (first_eos < count))
         n_emit2 = n_emit + count
         last_idx = jnp.maximum(count - 1, 0)
         t_next = jnp.where(count > 0, committed[batch_idx, last_idx],
                            t_prev)
-        return (rnd + 1, rng, t_next, done2, n_emit2, out2, cache3,
-                d_cache3)
+        valid2 = plen + n_emit2
+        t_prev2_next = hist2[batch_idx, jnp.maximum(valid2 - 2, 0)]
+        return (rnd + 1, rng, t_next, t_prev2_next, done2, n_emit2, out2,
+                hist2, cache3, d_cache3)
 
     def cond(state):
-        rnd, _, _, done, n_emit, *_ = state
+        rnd, _, _, _, done, n_emit, *_ = state
         # every active round commits >= 1 token, so n_new rounds suffice
         return (rnd < n_new) & jnp.any(~done & (n_emit < n_new))
 
-    state = (jnp.int32(0), rng, t_prev, done0, n_emit0, out, cache,
-             d_cache)
+    state = (jnp.int32(0), rng, t_prev, t_prev2_0, done0, n_emit0, out,
+             hist0, cache, d_cache)
     state = lax.while_loop(cond, round_body, state)
-    return state[5][:, :n_new]
+    return state[6][:, :n_new]

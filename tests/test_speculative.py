@@ -133,6 +133,70 @@ def test_temperature_runs_and_tokens_valid(params, draft_params):
     assert (got >= 0).all() and (got < 64).all()
 
 
+def test_ngram_greedy_exact_vs_generate(params):
+    """n-gram drafting (no draft model) must also be token-identical to
+    plain greedy generate, whatever the lookups propose."""
+    icfg = _greedy(24)
+    prompt = jnp.asarray(
+        np.random.RandomState(1).randint(1, 64, (2, 10)), jnp.int32)
+    want = generate(params, prompt, jax.random.key(2), cfg=TARGET,
+                    infer_cfg=icfg)
+    got = speculative_generate(
+        params, None, prompt, jax.random.key(3), cfg=TARGET,
+        infer_cfg=icfg, num_draft=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_ngram_greedy_exact_repetitive_prompt(params):
+    """Repetitive prompts are where lookups actually hit; output must
+    still be exact (and ragged batches must work)."""
+    icfg = _greedy(20)
+    rep = [5, 9, 3] * 5
+    ragged = jnp.asarray([rep, [7, 2, 7, 2, 7, 2, 7, 2] + [0] * 7],
+                         jnp.int32)
+    lens = jnp.asarray([15, 8], jnp.int32)
+    got = speculative_generate(
+        params, None, ragged, jax.random.key(1), cfg=TARGET,
+        infer_cfg=icfg, num_draft=3, prompt_lengths=lens)
+    for i, doc in enumerate(([5, 9, 3] * 5, [7, 2] * 4)):
+        want = generate(params, jnp.asarray([doc], jnp.int32),
+                        jax.random.key(0), cfg=TARGET, infer_cfg=icfg)
+        np.testing.assert_array_equal(np.asarray(got[i]),
+                                      np.asarray(want[0]))
+
+
+def test_ngram_lookup_unit():
+    """The lookup proposes the continuation of the latest EARLIER bigram
+    occurrence, pads when nothing matches or the window runs out."""
+    from cloud_server_tpu.inference.speculative import _ngram_drafts
+
+    #        0  1  2  3  4  5  6  7
+    hist = jnp.asarray([[4, 7, 1, 2, 4, 7, 0, 0],
+                        [3, 3, 3, 5, 6, 8, 0, 0]], jnp.int32)
+    valid = jnp.asarray([6, 6], jnp.int32)
+    # row 0: last two committed = (4, 7) at (4, 5); the earlier
+    # occurrence at (0, 1) -> proposes hist[2:5] = [1, 2, 4]
+    # row 1: last two = (6, 8), no earlier occurrence -> all pad
+    drafts = _ngram_drafts(hist, valid,
+                           jnp.asarray([4, 6]), jnp.asarray([7, 8]),
+                           3, pad=0)
+    np.testing.assert_array_equal(np.asarray(drafts),
+                                  [[1, 2, 4], [0, 0, 0]])
+
+
+def test_ngram_mismatched_args_raise(params, draft_params):
+    with pytest.raises(ValueError, match="together"):
+        speculative_generate(
+            params, None, jnp.asarray([[1, 2]], jnp.int32),
+            jax.random.key(0), cfg=TARGET, draft_cfg=DRAFT,
+            infer_cfg=_greedy(4))
+    with pytest.raises(ValueError, match="together"):
+        speculative_generate(
+            params, draft_params, jnp.asarray([[1, 2]], jnp.int32),
+            jax.random.key(0), cfg=TARGET, draft_cfg=None,
+            infer_cfg=_greedy(4))
+
+
 def test_accept_rule_identical_dists_accepts_all():
     """q == p => acceptance prob min(1, p/q) = 1: every draft survives and
     the corrective token comes from the bonus distribution."""
@@ -156,6 +220,27 @@ def test_accept_rule_zero_target_prob_rejects_first():
     n_acc, x = _accept_drafts(drafts, q, p, jax.random.key(0))
     assert int(n_acc[0]) == 0
     assert int(x[0]) == 7
+
+
+def test_point_mass_distribution_preserved():
+    """G=1 point-mass rule: the law of the committed token equals p
+    whatever fixed proposal is made (accept w.p. p(d), else sample from
+    p with d zeroed — the d mass moves to the accept branch exactly)."""
+    from cloud_server_tpu.inference.speculative import _accept_point_mass
+
+    v = 4
+    p = jnp.asarray([0.5, 0.25, 0.125, 0.125])
+    d = jnp.asarray([[2]], jnp.int32)  # always propose token 2
+    n = 4000
+    keys = jax.random.split(jax.random.key(0), n)
+
+    def one(key):
+        n_acc, x = _accept_point_mass(d, jnp.stack([p, p])[None], key)
+        return jnp.where(n_acc[0] > 0, d[0, 0], x[0])
+
+    toks = np.asarray(jax.vmap(one)(keys))
+    freq = np.bincount(toks, minlength=v) / n
+    np.testing.assert_allclose(freq, np.asarray(p), atol=0.03)
 
 
 def test_distribution_preserved_single_step():
