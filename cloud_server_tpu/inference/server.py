@@ -132,6 +132,64 @@ def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
         k_scale=k_scale, v_scale=v_scale), toks, lps
 
 
+@partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
+def _admit_batch_prefixed(params, state: SlotState, prefix_kv,
+                          prefix_len: jnp.ndarray, remainders: jnp.ndarray,
+                          true_lens: jnp.ndarray, slots: jnp.ndarray,
+                          rng: jax.Array, *, cfg: ModelConfig,
+                          infer_cfg: InferConfig):
+    """Admission via a cached common-prefix KV (prefix caching).
+
+    The prefix's cache entries (prefix_kv: dict with k/v (L, 1, P0, KH,
+    Dh) and optional k_scale/v_scale) are broadcast into a temp cache and
+    only the REMAINDER tokens (G, Rb) run through the model — as a
+    `verify_step` continuation at offset prefix_len, so the remainder
+    attends to the cached prefix exactly as a full prefill would. Cost
+    per admission drops from O(P0 + R) to O(R) model FLOPs.
+
+    Returns (state', first_tokens (G,), logprobs (G,)).
+    """
+    g, rb = remainders.shape
+    p0 = prefix_kv["k"].shape[2]
+    tmp = engine.init_cache(cfg, g, p0 + rb)
+
+    def put_prefix(buf, pre):
+        pre = jnp.broadcast_to(pre, (pre.shape[0], g) + pre.shape[2:])
+        return lax.dynamic_update_slice(
+            buf, pre.astype(buf.dtype), (0, 0, 0, 0, 0))
+
+    k = put_prefix(tmp.k, prefix_kv["k"])
+    v = put_prefix(tmp.v, prefix_kv["v"])
+    ks = vs = None
+    if tmp.k_scale is not None:
+        ks = put_prefix(tmp.k_scale, prefix_kv["k_scale"])
+        vs = put_prefix(tmp.v_scale, prefix_kv["v_scale"])
+    lengths0 = jnp.full((g,), prefix_len, jnp.int32)
+    tmp = engine.KVCache(k, v, lengths0, ks, vs)
+
+    logits, tmp = engine.verify_step(params, remainders, cfg, tmp)
+    last = logits[jnp.arange(g), true_lens - 1]  # (G, V)
+    toks = sample_logits(last, rng, infer_cfg)
+    lps = _token_logprobs(last, toks)
+    new_lens = prefix_len + true_lens
+
+    width = p0 + rb
+    k = state.k.at[:, slots, :width].set(tmp.k, mode="drop")
+    v = state.v.at[:, slots, :width].set(tmp.v, mode="drop")
+    k_scale = v_scale = None
+    if state.k_scale is not None:
+        k_scale = state.k_scale.at[:, slots, :width].set(tmp.k_scale,
+                                                         mode="drop")
+        v_scale = state.v_scale.at[:, slots, :width].set(tmp.v_scale,
+                                                         mode="drop")
+    return SlotState(
+        k=k, v=v,
+        length=state.length.at[slots].set(new_lens, mode="drop"),
+        last_token=state.last_token.at[slots].set(toks, mode="drop"),
+        active=state.active.at[slots].set(True, mode="drop"),
+        k_scale=k_scale, v_scale=v_scale), toks, lps
+
+
 def _decode_core(params, state: SlotState, rng: jax.Array,
                  cfg: ModelConfig, infer_cfg: InferConfig):
     """One decode step over all slots; inactive slots are frozen."""
@@ -231,7 +289,8 @@ class InferenceServer:
     def __init__(self, params, cfg: ModelConfig, infer_cfg: InferConfig, *,
                  max_slots: int = 8, max_len: int = 1024,
                  prompt_buckets: Sequence[int] | None = None, seed: int = 0,
-                 decode_chunk: int = 1):
+                 decode_chunk: int = 1,
+                 prefix_tokens: Sequence[int] | None = None):
         # Serving never needs f32 master weights: pre-cast float32 leaves to
         # the compute dtype once, instead of streaming 2x the bytes and
         # converting on every decode step. QTensor leaves stay quantized
@@ -270,6 +329,25 @@ class InferenceServer:
                 f"largest prompt bucket ({self.prompt_buckets[-1]}) exceeds "
                 f"max_len ({max_len}); the slot cache could not hold it")
         self.state = init_slot_state(cfg, max_slots, max_len)
+        # Prefix caching: prefill the shared prompt prefix (e.g. a system
+        # prompt) ONCE; admissions whose prompt extends it reuse the cached
+        # KV and only run their remainder through the model.
+        self._prefix: list[int] | None = None
+        self._prefix_kv: dict | None = None
+        if prefix_tokens:
+            pfx = list(prefix_tokens)
+            if len(pfx) >= max_len:
+                raise ValueError(
+                    f"prefix of {len(pfx)} tokens leaves no room within "
+                    f"max_len={max_len}")
+            tmp = engine.init_cache(cfg, 1, len(pfx))
+            _, tmp = engine.prefill(
+                self.params, jnp.asarray([pfx], jnp.int32), cfg, tmp)
+            self._prefix = pfx
+            self._prefix_kv = {"k": tmp.k, "v": tmp.v}
+            if tmp.k_scale is not None:
+                self._prefix_kv["k_scale"] = tmp.k_scale
+                self._prefix_kv["v_scale"] = tmp.v_scale
         self._slots: list[Request | None] = [None] * max_slots
         self._pending: collections.deque[Request] = collections.deque()
         self._lock = threading.Lock()
@@ -367,28 +445,78 @@ class InferenceServer:
                 group.append((slot, req))
         if not group:
             return
+        prefixed, plain = [], []
+        for gr in group:  # one predicate evaluation per request
+            (prefixed if self._use_prefix(gr[1]) else plain).append(gr)
+        if plain:
+            self._admit_group_plain(plain)
+        if prefixed:
+            self._admit_group_prefixed(prefixed)
 
-        pb = _bucket(max(len(r.prompt) for _, r in group),
-                     self.prompt_buckets)
+    def _remainder_buckets(self) -> list[int]:
+        """Bucket widths for prefix-remainder prefills: the standard
+        buckets that fit after the prefix, with the exact remaining
+        capacity always admissible as the last bucket (so a long prefix
+        can't silently disable the fast path)."""
+        rcap = self.max_len - len(self._prefix)
+        return [b for b in self.prompt_buckets if b < rcap] + [rcap]
+
+    def _use_prefix(self, req: Request) -> bool:
+        pfx = self._prefix
+        if pfx is None or len(req.prompt) <= len(pfx):
+            return False
+        return req.prompt[:len(pfx)] == pfx
+
+    def _pad_group(self, group, lens, buckets):
+        """Padded (token rows, true_lens, slot indices) numpy arrays for
+        an admission burst: width = the bucket of the longest entry, row
+        count = next power of two."""
+        pb = _bucket(max(lens), buckets)
         gpad = 1
         while gpad < len(group):
             gpad *= 2
-        prompts = np.full((gpad, pb), self.infer_cfg.pad_token_id, np.int32)
+        rows = np.full((gpad, pb), self.infer_cfg.pad_token_id, np.int32)
         true_lens = np.ones((gpad,), np.int32)
         # padding rows target slot == max_slots: out of range -> dropped
         slots = np.full((gpad,), self.max_slots, np.int32)
-        for i, (slot, req) in enumerate(group):
-            prompts[i, :len(req.prompt)] = req.prompt
-            true_lens[i] = len(req.prompt)
-            slots[i] = slot
-        self.state, toks, lps = _admit_batch(
-            self.params, self.state, jnp.asarray(prompts),
-            jnp.asarray(true_lens), jnp.asarray(slots),
-            self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg)
+        return rows, true_lens, slots
+
+    def _admit_group(self, group, token_rows, admit_fn) -> None:
+        """Shared burst plumbing: fill the padded arrays, dispatch one
+        batched admission, emit first tokens."""
+        rows, true_lens, slots = admit_fn["pad"](token_rows)
+        for i, toks_i in enumerate(token_rows):
+            rows[i, :len(toks_i)] = toks_i
+            true_lens[i] = len(toks_i)
+            slots[i] = group[i][0]
+        self.state, toks, lps = admit_fn["run"](rows, true_lens, slots)
         toks, lps = jax.device_get((toks, lps))
         for i, (slot, req) in enumerate(group):
             if self._emit(req, int(toks[i]), float(lps[i])):
                 self._finish(slot, req)
+
+    def _admit_group_plain(self, group) -> None:
+        token_rows = [r.prompt for _, r in group]
+        self._admit_group(group, token_rows, {
+            "pad": lambda tr: self._pad_group(
+                group, [len(t) for t in tr], self.prompt_buckets),
+            "run": lambda rows, tl, sl: _admit_batch(
+                self.params, self.state, jnp.asarray(rows),
+                jnp.asarray(tl), jnp.asarray(sl), self._next_rng(),
+                cfg=self.cfg, infer_cfg=self.infer_cfg),
+        })
+
+    def _admit_group_prefixed(self, group) -> None:
+        p0 = len(self._prefix)
+        token_rows = [req.prompt[p0:] for _, req in group]
+        self._admit_group(group, token_rows, {
+            "pad": lambda tr: self._pad_group(
+                group, [len(t) for t in tr], self._remainder_buckets()),
+            "run": lambda rows, tl, sl: _admit_batch_prefixed(
+                self.params, self.state, self._prefix_kv, jnp.int32(p0),
+                jnp.asarray(rows), jnp.asarray(tl), jnp.asarray(sl),
+                self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg),
+        })
 
     @property
     def num_active(self) -> int:

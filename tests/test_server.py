@@ -228,3 +228,51 @@ def test_logprobs_recorded(devices8):
                         CFG, cache)
     want = float(jax.nn.log_softmax(logits[0])[req.tokens[0]])
     np.testing.assert_allclose(req.logprobs[0], want, rtol=1e-4)
+
+
+def test_prefix_caching_parity(params):
+    """A server with a cached common prefix must produce exactly the
+    outputs of a plain server, for matching, non-matching, and
+    prefix-equal prompts alike."""
+    prefix = [9, 4, 7, 7, 2, 5]
+    prompts = [prefix + [3, 1],            # matches -> fast path
+               prefix + [8],               # matches -> fast path
+               [1, 2, 3],                  # no match -> plain path
+               list(prefix)]               # equal -> plain path (no rem.)
+    srv_plain = InferenceServer(params, CFG, GREEDY, max_slots=4,
+                                max_len=64)
+    want = srv_plain.generate(prompts, max_new_tokens=8)
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=4, max_len=64,
+                          prefix_tokens=prefix)
+    got = srv.generate(prompts, max_new_tokens=8)
+    assert got == want
+    # logprobs must match too (first token comes from the fast path)
+    srv2 = InferenceServer(params, CFG, GREEDY, max_slots=1, max_len=64,
+                           prefix_tokens=prefix)
+    r_fast = srv2.submit(prompts[0], max_new_tokens=4)
+    srv2.run_until_idle()
+    r_plain = srv_plain.submit(prompts[0], max_new_tokens=4)
+    srv_plain.run_until_idle()
+    import numpy as np
+    np.testing.assert_allclose(r_fast.logprobs, r_plain.logprobs,
+                               rtol=1e-4)
+
+
+def test_prefix_caching_int8_kv(params):
+    """Prefix caching composes with the int8 KV cache."""
+    import dataclasses
+    cfg8 = dataclasses.replace(CFG, kv_cache_dtype="int8")
+    prefix = [9, 4, 7, 2]
+    prompts = [prefix + [3, 1], prefix + [8, 8, 6]]
+    want = InferenceServer(params, cfg8, GREEDY, max_slots=2,
+                           max_len=64).generate(prompts, max_new_tokens=6)
+    got = InferenceServer(params, cfg8, GREEDY, max_slots=2, max_len=64,
+                          prefix_tokens=prefix).generate(
+        prompts, max_new_tokens=6)
+    assert got == want
+
+
+def test_prefix_too_long_rejected(params):
+    with pytest.raises(ValueError, match="prefix"):
+        InferenceServer(params, CFG, GREEDY, max_slots=1, max_len=16,
+                        prefix_tokens=list(range(16)))
