@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve the EMA-averaged weights from a checkpoint "
                    "trained with ema_decay > 0 (reads the checkpoint's "
                    "'ema' item — one params-sized restore)")
+    p.add_argument("--prefix", metavar="TEXT",
+                   help="shared prompt prefix (e.g. a system prompt): its "
+                   "KV is prefilled once and cached; prompts extending it "
+                   "only run their remainder (prefix caching). Applies to "
+                   "batch and --serve-http serving")
     p.add_argument("--serve-http", type=int, metavar="PORT", default=None,
                    help="instead of batch generation, run the continuous-"
                    "batching server behind an HTTP streaming endpoint "
@@ -219,9 +224,11 @@ def main(argv=None) -> None:
                 "--serve-http would silently serve without speculation")
         from cloud_server_tpu.inference.http_server import HttpFrontend
         max_len = args.max_len or model_cfg.max_seq_len
+        prefix_toks = tok.encode(args.prefix) if args.prefix else None
         srv = InferenceServer(params, model_cfg, infer_cfg, max_slots=8,
                               max_len=max_len, seed=args.seed,
-                              decode_chunk=args.decode_chunk).start()
+                              decode_chunk=args.decode_chunk,
+                              prefix_tokens=prefix_toks).start()
         front = HttpFrontend(srv, tokenizer=tok, port=args.serve_http)
         front.start()
         host, port = front.address
@@ -252,6 +259,10 @@ def main(argv=None) -> None:
         if args.draft_config and args.ngram_draft:
             raise SystemExit("--draft-config and --ngram-draft are "
                              "mutually exclusive draft sources")
+        if args.prefix:
+            raise SystemExit(
+                "--prefix is a serving-path feature; the speculative "
+                "batch path would silently ignore it")
         draft_cfg = draft_params = None
         if args.draft_config:
             with open(args.draft_config) as f:
@@ -307,9 +318,14 @@ def main(argv=None) -> None:
     longest = max(len(e) for e in encoded)
     max_len = args.max_len or min(model_cfg.max_seq_len,
                                   longest + args.max_new)
+    prefix_toks = (tok.encode(args.prefix,
+                              add_bos=args.add_bos
+                              and tok.bos_id is not None)
+                   if args.prefix else None)
     srv = InferenceServer(params, model_cfg, infer_cfg,
                           max_slots=min(8, len(encoded)), max_len=max_len,
-                          seed=args.seed, decode_chunk=args.decode_chunk)
+                          seed=args.seed, decode_chunk=args.decode_chunk,
+                          prefix_tokens=prefix_toks)
     outs = srv.generate(encoded, max_new_tokens=args.max_new)
     for prompt, out in zip(prompts, outs):
         print(f"=== {prompt!r}")

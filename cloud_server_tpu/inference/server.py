@@ -134,7 +134,7 @@ def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
 
 @partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
 def _admit_batch_prefixed(params, state: SlotState, prefix_kv,
-                          prefix_len: jnp.ndarray, remainders: jnp.ndarray,
+                          remainders: jnp.ndarray,
                           true_lens: jnp.ndarray, slots: jnp.ndarray,
                           rng: jax.Array, *, cfg: ModelConfig,
                           infer_cfg: InferConfig):
@@ -164,14 +164,14 @@ def _admit_batch_prefixed(params, state: SlotState, prefix_kv,
     if tmp.k_scale is not None:
         ks = put_prefix(tmp.k_scale, prefix_kv["k_scale"])
         vs = put_prefix(tmp.v_scale, prefix_kv["v_scale"])
-    lengths0 = jnp.full((g,), prefix_len, jnp.int32)
+    lengths0 = jnp.full((g,), p0, jnp.int32)  # static prefix width
     tmp = engine.KVCache(k, v, lengths0, ks, vs)
 
     logits, tmp = engine.verify_step(params, remainders, cfg, tmp)
     last = logits[jnp.arange(g), true_lens - 1]  # (G, V)
     toks = sample_logits(last, rng, infer_cfg)
     lps = _token_logprobs(last, toks)
-    new_lens = prefix_len + true_lens
+    new_lens = p0 + true_lens
 
     width = p0 + rb
     k = state.k.at[:, slots, :width].set(tmp.k, mode="drop")
@@ -290,7 +290,8 @@ class InferenceServer:
                  max_slots: int = 8, max_len: int = 1024,
                  prompt_buckets: Sequence[int] | None = None, seed: int = 0,
                  decode_chunk: int = 1,
-                 prefix_tokens: Sequence[int] | None = None):
+                 prefix_tokens: Sequence[int] | None = None,
+                 prefix_remainder_cap: int = 1024):
         # Serving never needs f32 master weights: pre-cast float32 leaves to
         # the compute dtype once, instead of streaming 2x the bytes and
         # converting on every decode step. QTensor leaves stay quantized
@@ -334,6 +335,7 @@ class InferenceServer:
         # KV and only run their remainder through the model.
         self._prefix: list[int] | None = None
         self._prefix_kv: dict | None = None
+        self.prefix_remainder_cap = prefix_remainder_cap
         if prefix_tokens:
             pfx = list(prefix_tokens)
             if len(pfx) >= max_len:
@@ -455,68 +457,73 @@ class InferenceServer:
 
     def _remainder_buckets(self) -> list[int]:
         """Bucket widths for prefix-remainder prefills: the standard
-        buckets that fit after the prefix, with the exact remaining
-        capacity always admissible as the last bucket (so a long prefix
-        can't silently disable the fast path)."""
-        rcap = self.max_len - len(self._prefix)
+        buckets that fit the fast path's remainder cap, with the exact
+        cap always admissible as the last bucket (so a long prefix can't
+        silently disable the fast path)."""
+        rcap = min(self.max_len - len(self._prefix),
+                   self.prefix_remainder_cap)
         return [b for b in self.prompt_buckets if b < rcap] + [rcap]
 
     def _use_prefix(self, req: Request) -> bool:
         pfx = self._prefix
         if pfx is None or len(req.prompt) <= len(pfx):
             return False
+        if len(req.prompt) - len(pfx) > self._remainder_buckets()[-1]:
+            # verify_step's dense attention is fine for moderate
+            # remainders but would materialise O(R x (P0+R)) scores for
+            # huge ones — the plain (flash-capable) prefill wins there
+            return False
         return req.prompt[:len(pfx)] == pfx
 
-    def _pad_group(self, group, lens, buckets):
+    def _pad_group(self, group, token_rows, buckets):
         """Padded (token rows, true_lens, slot indices) numpy arrays for
         an admission burst: width = the bucket of the longest entry, row
-        count = next power of two."""
-        pb = _bucket(max(lens), buckets)
+        count = next power of two; rows filled, padding rows target
+        slot == max_slots (out of range -> dropped by the scatters)."""
+        pb = _bucket(max(len(t) for t in token_rows), buckets)
         gpad = 1
         while gpad < len(group):
             gpad *= 2
         rows = np.full((gpad, pb), self.infer_cfg.pad_token_id, np.int32)
         true_lens = np.ones((gpad,), np.int32)
-        # padding rows target slot == max_slots: out of range -> dropped
         slots = np.full((gpad,), self.max_slots, np.int32)
-        return rows, true_lens, slots
-
-    def _admit_group(self, group, token_rows, admit_fn) -> None:
-        """Shared burst plumbing: fill the padded arrays, dispatch one
-        batched admission, emit first tokens."""
-        rows, true_lens, slots = admit_fn["pad"](token_rows)
         for i, toks_i in enumerate(token_rows):
             rows[i, :len(toks_i)] = toks_i
             true_lens[i] = len(toks_i)
             slots[i] = group[i][0]
-        self.state, toks, lps = admit_fn["run"](rows, true_lens, slots)
+        return rows, true_lens, slots
+
+    def _admit_group(self, group, token_rows, buckets, run_fn) -> None:
+        """Shared burst plumbing: pad, dispatch one batched admission,
+        emit first tokens."""
+        rows, true_lens, slots = self._pad_group(group, token_rows,
+                                                 buckets)
+        self.state, toks, lps = run_fn(
+            jnp.asarray(rows), jnp.asarray(true_lens), jnp.asarray(slots))
         toks, lps = jax.device_get((toks, lps))
         for i, (slot, req) in enumerate(group):
             if self._emit(req, int(toks[i]), float(lps[i])):
                 self._finish(slot, req)
 
     def _admit_group_plain(self, group) -> None:
-        token_rows = [r.prompt for _, r in group]
-        self._admit_group(group, token_rows, {
-            "pad": lambda tr: self._pad_group(
-                group, [len(t) for t in tr], self.prompt_buckets),
-            "run": lambda rows, tl, sl: _admit_batch(
-                self.params, self.state, jnp.asarray(rows),
-                jnp.asarray(tl), jnp.asarray(sl), self._next_rng(),
-                cfg=self.cfg, infer_cfg=self.infer_cfg),
-        })
+        def run(rows, tl, sl):
+            return _admit_batch(self.params, self.state, rows, tl, sl,
+                                self._next_rng(), cfg=self.cfg,
+                                infer_cfg=self.infer_cfg)
+
+        self._admit_group(group, [r.prompt for _, r in group],
+                          self.prompt_buckets, run)
 
     def _admit_group_prefixed(self, group) -> None:
         p0 = len(self._prefix)
-        token_rows = [req.prompt[p0:] for _, req in group]
-        self._admit_group(group, token_rows, {
-            "pad": lambda tr: self._pad_group(
-                group, [len(t) for t in tr], self._remainder_buckets()),
-            "run": lambda rows, tl, sl: _admit_batch_prefixed(
-                self.params, self.state, self._prefix_kv, jnp.int32(p0),
-                jnp.asarray(rows), jnp.asarray(tl), jnp.asarray(sl),
-                self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg),
-        })
+
+        def run(rows, tl, sl):
+            return _admit_batch_prefixed(
+                self.params, self.state, self._prefix_kv, rows, tl, sl,
+                self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg)
+
+        self._admit_group(group, [req.prompt[p0:] for _, req in group],
+                          self._remainder_buckets(), run)
 
     @property
     def num_active(self) -> int:
