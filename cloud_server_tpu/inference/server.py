@@ -336,6 +336,9 @@ class InferenceServer:
         self._prefix: list[int] | None = None
         self._prefix_kv: dict | None = None
         self.prefix_remainder_cap = prefix_remainder_cap
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self._warned_prefix_miss = False
         if prefix_tokens:
             pfx = list(prefix_tokens)
             if len(pfx) >= max_len:
@@ -350,6 +353,11 @@ class InferenceServer:
             if tmp.k_scale is not None:
                 self._prefix_kv["k_scale"] = tmp.k_scale
                 self._prefix_kv["v_scale"] = tmp.v_scale
+            # remainder bucket list is a constant; precompute for the
+            # per-request predicate on the scheduler hot path
+            rcap = min(max_len - len(pfx), prefix_remainder_cap)
+            self._rem_buckets = ([b for b in self.prompt_buckets
+                                  if b < rcap] + [rcap])
         self._slots: list[Request | None] = [None] * max_slots
         self._pending: collections.deque[Request] = collections.deque()
         self._lock = threading.Lock()
@@ -455,25 +463,35 @@ class InferenceServer:
         if prefixed:
             self._admit_group_prefixed(prefixed)
 
-    def _remainder_buckets(self) -> list[int]:
-        """Bucket widths for prefix-remainder prefills: the standard
-        buckets that fit the fast path's remainder cap, with the exact
-        cap always admissible as the last bucket (so a long prefix can't
-        silently disable the fast path)."""
-        rcap = min(self.max_len - len(self._prefix),
-                   self.prefix_remainder_cap)
-        return [b for b in self.prompt_buckets if b < rcap] + [rcap]
-
     def _use_prefix(self, req: Request) -> bool:
+        """Fast-path predicate; also tracks hit/miss counters. A miss is
+        NOT necessarily an error (mixed traffic is expected) but a server
+        that never hits usually means the prefix isn't a token-level
+        prefix of the prompts — e.g. a BPE tokenizer merging across the
+        prefix/remainder text boundary — so the first miss warns once.
+        """
         pfx = self._prefix
-        if pfx is None or len(req.prompt) <= len(pfx):
+        if pfx is None:
             return False
-        if len(req.prompt) - len(pfx) > self._remainder_buckets()[-1]:
-            # verify_step's dense attention is fine for moderate
-            # remainders but would materialise O(R x (P0+R)) scores for
-            # huge ones — the plain (flash-capable) prefill wins there
-            return False
-        return req.prompt[:len(pfx)] == pfx
+        ok = (len(req.prompt) > len(pfx)
+              # cap: verify_step's dense attention is fine for moderate
+              # remainders but would materialise O(R x (P0+R)) scores
+              # for huge ones — the plain (flash-capable) prefill wins
+              and len(req.prompt) - len(pfx) <= self._rem_buckets[-1]
+              and req.prompt[:len(pfx)] == pfx)
+        if ok:
+            self.prefix_hits += 1
+        else:
+            self.prefix_misses += 1
+            if not self._warned_prefix_miss:
+                self._warned_prefix_miss = True
+                import sys
+                print("[server] request did not match the cached prefix "
+                      "(token-level comparison) — with a BPE tokenizer, "
+                      "text that merges across the prefix boundary never "
+                      "matches; check prefix_hits/prefix_misses",
+                      file=sys.stderr)
+        return ok
 
     def _pad_group(self, group, token_rows, buckets):
         """Padded (token rows, true_lens, slot indices) numpy arrays for
@@ -523,7 +541,7 @@ class InferenceServer:
                 self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg)
 
         self._admit_group(group, [req.prompt[p0:] for _, req in group],
-                          self._remainder_buckets(), run)
+                          self._rem_buckets, run)
 
     @property
     def num_active(self) -> int:

@@ -232,3 +232,51 @@ class Watchdog:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+
+class FaultInjector:
+    """Deterministic fault injection for resilience drills and tests.
+
+    A train-loop hook that fires configured faults at exact steps, so the
+    recovery machinery (NaNGuard, PreemptionHandler, checkpoint resume,
+    `_fail_all`-style unblocking) can be exercised on demand instead of
+    waiting for real hardware flakiness. Faults:
+
+      * "preempt"  — simulate a preemption signal at the step boundary
+                     (raises KeyboardInterrupt, the same control flow a
+                     SIGTERM produces through PreemptionHandler), which
+                     the loop turns into an emergency checkpoint.
+      * "nan_loss" — overwrite metrics[metric] with NaN so the NaNGuard
+                     path (patience, divergence abort) is driven end to
+                     end. Mutates the metrics dict only — model state is
+                     untouched, mirroring a transient bad batch.
+      * "crash"    — raise RuntimeError, the generic unrecoverable error.
+
+    Faults are (step, kind) pairs; each fires once. The injector is a
+    plain hook — compose it BEFORE the guards it is meant to trigger in
+    the loop's hook list.
+    """
+
+    KINDS = ("preempt", "nan_loss", "crash")
+
+    def __init__(self, faults: dict[int, str], metric: str = "loss"):
+        for step, kind in faults.items():
+            if kind not in self.KINDS:
+                raise ValueError(f"unknown fault kind {kind!r} at step "
+                                 f"{step}; expected one of {self.KINDS}")
+        self._faults = dict(faults)
+        self.metric = metric
+        self.fired: list[tuple[int, str]] = []
+
+    def __call__(self, step: int, state, metrics: dict):
+        kind = self._faults.pop(step, None)
+        if kind is None:
+            return None
+        self.fired.append((step, kind))
+        if kind == "preempt":
+            raise KeyboardInterrupt(f"injected preemption (step {step})")
+        if kind == "nan_loss":
+            import jax.numpy as jnp
+            metrics[self.metric] = jnp.float32(float("nan"))
+            return None
+        raise RuntimeError(f"injected crash (step {step})")

@@ -135,3 +135,61 @@ def test_watchdog_stays_quiet_with_heartbeats():
             wd.beat()
             time.sleep(0.08)
     assert not wd.fired and not fired
+
+
+# -- FaultInjector ----------------------------------------------------------
+
+def test_fault_injector_preempt_saves_and_resumes(tmp_path, devices8):
+    """Injected preemption exercises the emergency-save + resume path
+    without any real signal delivery."""
+    from cloud_server_tpu.utils.failure import FaultInjector
+
+    ck = str(tmp_path / "ck")
+    inj = FaultInjector({3: "preempt"})
+    with pytest.raises(KeyboardInterrupt, match="injected"):
+        train_loop(TINY, TCFG, _dataset(),
+                   loop_cfg=LoopConfig(log_interval=100, checkpoint_dir=ck,
+                                       checkpoint_interval=100),
+                   hooks=[inj])
+    assert inj.fired == [(3, "preempt")]
+    assert Checkpointer(ck).latest_step() == 3
+    resumed = train_loop(TINY, TCFG, _dataset(),
+                         loop_cfg=LoopConfig(log_interval=100,
+                                             checkpoint_dir=ck,
+                                             checkpoint_interval=100))
+    assert int(resumed.step) == TCFG.total_steps
+
+
+def test_fault_injector_nan_drives_guard(devices8):
+    """Injected NaN loss must trip a downstream NaNGuard exactly like a
+    real divergence (hook order: injector before guard)."""
+    from cloud_server_tpu.utils.failure import FaultInjector
+
+    inj = FaultInjector({4: "nan_loss"})
+    guard = NaNGuard(check_interval=1, patience=0)
+    with pytest.raises(TrainingDiverged):
+        train_loop(TINY, TCFG, _dataset(),
+                   loop_cfg=LoopConfig(log_interval=100),
+                   hooks=[inj, guard])
+    assert inj.fired == [(4, "nan_loss")]
+
+
+def test_fault_injector_crash_does_not_save(tmp_path, devices8):
+    """A generic crash must NOT checkpoint (corrupt-state protection) —
+    mirrors the loop's non-KeyboardInterrupt error path."""
+    from cloud_server_tpu.utils.failure import FaultInjector
+
+    ck = str(tmp_path / "ck")
+    with pytest.raises(RuntimeError, match="injected crash"):
+        train_loop(TINY, TCFG, _dataset(),
+                   loop_cfg=LoopConfig(log_interval=100, checkpoint_dir=ck,
+                                       checkpoint_interval=100),
+                   hooks=[FaultInjector({2: "crash"})])
+    assert Checkpointer(ck).latest_step() is None
+
+
+def test_fault_injector_validates_kinds():
+    from cloud_server_tpu.utils.failure import FaultInjector
+
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultInjector({1: "meteor"})

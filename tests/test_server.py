@@ -246,6 +246,7 @@ def test_prefix_caching_parity(params):
                           prefix_tokens=prefix)
     got = srv.generate(prompts, max_new_tokens=8)
     assert got == want
+    assert srv.prefix_hits == 2 and srv.prefix_misses == 2
     # logprobs must match too (first token comes from the fast path)
     srv2 = InferenceServer(params, CFG, GREEDY, max_slots=1, max_len=64,
                            prefix_tokens=prefix)
@@ -276,6 +277,7 @@ def test_prefix_caching_int8_kv(params):
     got = mk().generate(prompts, max_new_tokens=6)
     assert got == mk().generate(prompts, max_new_tokens=6)  # deterministic
     for g, w in zip(got, want):
+        assert len(g) == len(w), (g, w)  # zip below must not truncate
         assert sum(a != b for a, b in zip(g, w)) <= 1, (g, w)
 
 
