@@ -89,6 +89,12 @@ class MixtureDataset:
             raise ValueError(f"weights must be positive, got {weights}")
         self._datasets = list(datasets)
         self._cum = np.cumsum(w / w.sum())
+        for k, d in enumerate(datasets):
+            if d.seq_len != datasets[0].seq_len:
+                raise ValueError(
+                    f"all mixture sources must share seq_len: source {k} "
+                    f"has seq_len={d.seq_len} != {datasets[0].seq_len} "
+                    "(source 0) — retokenize or drop the mismatched file")
         self.seq_len = datasets[0].seq_len
         self.seed = seed
         if num_examples is not None and num_examples <= 0:

@@ -64,8 +64,11 @@ def _kv_quant(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def _kv_dequant(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
-    """Inverse of `_kv_quant`; XLA fuses this into the attention matmul
-    that consumes it, so no dequantized cache copy lands in HBM."""
+    """Inverse of `_kv_quant` — test/reference use only. The hot paths
+    never call it: materialising the dequantized cache costs a full-cache
+    HBM round-trip per layer (a measured ~36% of decode throughput), so
+    attention instead folds the scales into scores/probs and consumes the
+    int8 buffers directly (`causal_attention(k_scale=..., v_scale=...)`)."""
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
@@ -178,19 +181,19 @@ def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
 
         # int8 caches go to the kernel RAW with their scales — dequant
         # happens in VMEM, so decode streams half the HBM bytes. (The XLA
-        # path below dequantizes outside attention, which materialises a
-        # per-layer copy; pallas is the fast int8 path.)
+        # path below also consumes int8 raw, folding scales into
+        # scores/probs inside the einsums.)
         def attend(q, k_cache, v_cache, k_scale=None, v_scale=None):
             return decode_attention(q, k_cache, v_cache, cache.length + 1,
                                     k_scale=k_scale, v_scale=v_scale)
     elif cfg.decode_attention_impl == "xla":
+        # int8 caches: scales fold into scores/probs inside the op, so the
+        # int8 buffers feed the einsums raw — no dequantized HBM copy.
         def attend(q, k_cache, v_cache, k_scale=None, v_scale=None):
-            if k_scale is not None:
-                k_cache = _kv_dequant(k_cache, k_scale, cfg.dtype)
-                v_cache = _kv_dequant(v_cache, v_scale, cfg.dtype)
             return causal_attention(q, k_cache, v_cache,
                                     q_positions=positions,
-                                    kv_length=cache.length + 1)
+                                    kv_length=cache.length + 1,
+                                    k_scale=k_scale, v_scale=v_scale)
     else:
         raise ValueError(
             f"unknown decode_attention_impl: {cfg.decode_attention_impl!r}")
@@ -259,6 +262,7 @@ def verify_step(params, tokens: jnp.ndarray, cfg: ModelConfig,
     for layer_idx in range(cfg.num_layers):
         lp = jax.tree.map(lambda w: w[layer_idx], params["layers"])
         q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin, pos)
+        scales = {}
         if int8_kv:
             kq, ksc = _kv_quant(k)
             vq, vsc = _kv_quant(v)
@@ -266,18 +270,20 @@ def verify_step(params, tokens: jnp.ndarray, cfg: ModelConfig,
             v_all = v_all.at[layer_idx, batch_idx[:, None], pos].set(vq)
             ks_all = ks_all.at[layer_idx, batch_idx[:, None], pos].set(ksc)
             vs_all = vs_all.at[layer_idx, batch_idx[:, None], pos].set(vsc)
-            k_lay = _kv_dequant(k_all[layer_idx], ks_all[layer_idx],
-                                cfg.dtype)
-            v_lay = _kv_dequant(v_all[layer_idx], vs_all[layer_idx],
-                                cfg.dtype)
+            # scales fold into scores/probs inside the op — no (B, max_len,
+            # KH, Dh)-sized dequantized copy per layer per round (that copy
+            # used to erase int8's memory win on every speculative round
+            # and prefix admission)
+            scales = dict(k_scale=ks_all[layer_idx],
+                          v_scale=vs_all[layer_idx])
         else:
             k_all = k_all.at[layer_idx, batch_idx[:, None], pos].set(k)
             v_all = v_all.at[layer_idx, batch_idx[:, None], pos].set(v)
-            k_lay, v_lay = k_all[layer_idx], v_all[layer_idx]
         # q_positions give the in-window causal structure; kv_length masks
         # both stale cache entries and the other sequences' longer windows.
-        o = causal_attention(q, k_lay, v_lay,
-                             q_positions=pos, kv_length=cache.length + kk)
+        o = causal_attention(q, k_all[layer_idx], v_all[layer_idx],
+                             q_positions=pos, kv_length=cache.length + kk,
+                             **scales)
         x = transformer.attention_out(x, o, lp, cfg)
         x = _mlp_apply(x, lp, cfg)
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)

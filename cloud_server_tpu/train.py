@@ -130,7 +130,12 @@ def main(argv=None) -> None:
     elif args.data:
         specs = []
         for entry in args.data:
-            path, _, w = entry.rpartition(":")
+            path, sep, w = entry.rpartition(":")
+            if sep and path and not w:
+                raise SystemExit(
+                    f"--data entry {entry!r} has an empty weight after "
+                    "':' — use path:weight (e.g. data.bin:2.0) or just "
+                    "the path")
             try:
                 weight, path = (float(w), path) if path else (1.0, entry)
             except ValueError:

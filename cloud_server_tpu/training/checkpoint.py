@@ -89,25 +89,35 @@ class Checkpointer:
         if step in self._mngr.all_steps():
             return False  # even force=True must not collide with a done save
         ema = ema_params(state.opt_state)
-        if ema is None:
+        # The manager locks into the item layout of the first step on disk.
+        # Detect a legacy single-item directory (pre-EMA steps, no "ema"
+        # item dir) by inspecting the on-disk layout — the same signal
+        # restore() uses — rather than catching ValueError, which would
+        # also swallow genuine tree/structure failures in StandardSave.
+        legacy_single_item = False
+        if ema is not None:
+            root = os.fspath(self._mngr.directory)
+            # Only FINALIZED steps count: an in-flight async save lives in
+            # a tmp-suffixed directory (no `root/step/` yet), so a
+            # composite save still finalizing must not flip this run's
+            # classification to legacy.
+            prior = [s for s in self._mngr.all_steps()
+                     if os.path.isdir(os.path.join(root, str(s)))]
+            legacy_single_item = bool(prior) and not any(
+                os.path.isdir(os.path.join(root, str(s), "ema"))
+                for s in prior)
+            if legacy_single_item:
+                print("[checkpoint] directory predates the 'ema' item; "
+                      "saving state only (ema still restorable via the "
+                      "full state)", file=sys.stderr)
+        if ema is None or legacy_single_item:
             return self._mngr.save(step, args=ocp.args.StandardSave(state),
                                    metrics=metrics, force=force)
-        try:
-            return self._mngr.save(
-                step, args=ocp.args.Composite(
-                    default=ocp.args.StandardSave(state),
-                    ema=ocp.args.StandardSave(ema)),
-                metrics=metrics, force=force)
-        except ValueError:
-            # The manager locked into single-item mode from pre-EMA steps
-            # already on disk (resuming an old run with ema newly enabled):
-            # keep checkpointing the state; the separate ema item resumes
-            # at the next fresh directory.
-            print("[checkpoint] directory predates the 'ema' item; saving "
-                  "state only (ema still restorable via the full state)",
-                  file=sys.stderr)
-            return self._mngr.save(step, args=ocp.args.StandardSave(state),
-                                   metrics=metrics, force=force)
+        return self._mngr.save(
+            step, args=ocp.args.Composite(
+                default=ocp.args.StandardSave(state),
+                ema=ocp.args.StandardSave(ema)),
+            metrics=metrics, force=force)
 
     # -- restore ------------------------------------------------------------
 
