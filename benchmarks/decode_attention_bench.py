@@ -1,0 +1,151 @@
+"""Steady-state decode-attention microbench on the real TPU.
+
+Compares, at the serving-bench shape (B=8 slots, S=1024 context, MHA
+KH=16, Dh=64, L-free single-layer pools):
+
+  * xla-dense      — `causal_attention` over the contiguous cache (the
+                     engine's default decode path)
+  * xla-int8       — same, int8 cache with scales folded into the einsums
+  * paged-pallas   — `ops.paged_attention` kernel (W in {1, 4})
+  * paged-int8     — the kernel on int8 pools + scale pools
+  * paged-xla      — the gather-based reference (expected slow; sanity)
+
+Method: one jit per case runs a `lax.scan` of ITERS attention calls with
+the output fed back into the query (so nothing hoists), amortising the
+axon tunnel's per-dispatch ~3 ms. Reported per-iteration time divides by
+ITERS; effective bandwidth counts one cache read per iteration.
+
+Run:  python benchmarks/decode_attention_bench.py
+(KEEP the axon env vars; run nothing else concurrently.)
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from cloud_server_tpu.inference.engine import _kv_quant
+from cloud_server_tpu.ops.attention import causal_attention
+from cloud_server_tpu.ops.paged_attention import paged_attention
+
+B, S, H, KH, D = 8, 1024, 16, 16, 64
+PS = 64
+ITERS = 50
+
+
+def _timeit(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / ITERS
+    return dt
+
+
+def _scan(body, q0):
+    def f(q, _):
+        return body(q), None
+
+    return lax.scan(f, q0, None, length=ITERS)[0]
+
+
+def main():
+    key = jax.random.key(0)
+    ks = jax.random.split(key, 8)
+    dtype = jnp.bfloat16
+    lens = jnp.full((B,), S, jnp.int32)
+
+    # contiguous cache (engine layout)
+    k_cat = jax.random.normal(ks[0], (B, S, KH, D), dtype)
+    v_cat = jax.random.normal(ks[1], (B, S, KH, D), dtype)
+    kq_cat, ksc_cat = _kv_quant(k_cat)
+    vq_cat, vsc_cat = _kv_quant(v_cat)
+
+    # paged pools (1 "layer")
+    mp = S // PS
+    num_pages = B * mp
+    perm = np.random.RandomState(0).permutation(num_pages)
+    tables = jnp.asarray(perm.reshape(B, mp), jnp.int32)
+    k_pool = jax.random.normal(ks[2], (1, num_pages, KH, PS, D), dtype)
+    v_pool = jax.random.normal(ks[3], (1, num_pages, KH, PS, D), dtype)
+    kq_pool, ksc_pool = _kv_quant(k_pool)
+    vq_pool, vsc_pool = _kv_quant(v_pool)
+    ksc_pool, vsc_pool = ksc_pool[..., 0], vsc_pool[..., 0]
+
+    cache_bytes = {"bf16": 2 * B * S * KH * D * 2,
+                   "int8": 2 * B * S * KH * D + 2 * B * S * KH * 4}
+
+    results = {}
+
+    def report(name, dt, kind):
+        gbs = cache_bytes[kind] / dt / 1e9
+        results[name] = dt
+        print(f"{name:28s} {dt * 1e6:9.1f} us/iter   {gbs:7.1f} GB/s eff")
+
+    # ---- XLA dense over contiguous cache --------------------------------
+    @jax.jit
+    def xla_dense(q0):
+        def body(q):
+            o = causal_attention(q, k_cat, v_cat,
+                                 q_positions=(lens - 1)[:, None],
+                                 kv_length=lens)
+            return o.astype(q.dtype)
+        return _scan(body, q0)
+
+    q0 = jax.random.normal(ks[4], (B, 1, H, D), dtype)
+    report("xla-dense bf16 W=1", _timeit(xla_dense, q0), "bf16")
+
+    @jax.jit
+    def xla_int8(q0):
+        def body(q):
+            o = causal_attention(q, kq_cat, vq_cat,
+                                 q_positions=(lens - 1)[:, None],
+                                 kv_length=lens,
+                                 k_scale=ksc_cat, v_scale=vsc_cat)
+            return o.astype(q.dtype)
+        return _scan(body, q0)
+
+    report("xla-dense int8 W=1", _timeit(xla_int8, q0), "int8")
+
+    # ---- paged kernel ----------------------------------------------------
+    for w in (1, 4):
+        qw = jax.random.normal(ks[5], (B, w, H, D), dtype)
+        for npb in (2, 4, 8):
+            @jax.jit
+            def paged(q0, npb=npb, w=w):
+                def body(q):
+                    o = paged_attention(q, k_pool, v_pool, lens, tables, 0,
+                                        pages_per_block=npb,
+                                        interpret=False)
+                    return o.astype(q.dtype)
+                return _scan(body, q0)
+
+            report(f"paged-pallas bf16 W={w} npb={npb}",
+                   _timeit(paged, qw), "bf16")
+
+        @jax.jit
+        def paged8(q0, w=w):
+            def body(q):
+                o = paged_attention(q, kq_pool, vq_pool, lens, tables, 0,
+                                    pages_per_block=4, interpret=False,
+                                    k_scale_pool=ksc_pool,
+                                    v_scale_pool=vsc_pool)
+                return o.astype(q.dtype)
+            return _scan(body, q0)
+
+        report(f"paged-pallas int8 W={w} npb=4", _timeit(paged8, qw),
+               "int8")
+
+    base = results.get("xla-dense bf16 W=1")
+    for name, dt in results.items():
+        print(f"{name:28s} speedup vs xla-dense: {base / dt:5.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,184 @@
+"""Host-side page allocator: refcounts, prefix sharing, LRU reuse.
+
+Pure-Python bookkeeping for the device page pool
+(`paged_engine.PagedKVCache`). The device never allocates — the scheduler
+reserves every page a request can touch at admission time (prompt +
+max_new_tokens + speculative slack), so a request can never OOM
+mid-decode and no preemption path is needed.
+
+Sharing model (radix-style, page granularity): a FULL page of kv is
+identified by the token chain that produced it — the cache key is
+(parent_page_id, page_tokens), so a chain of keys spells out the whole
+prefix. Walking a prompt page-by-page either extends a chain of hits
+(each hit bumps a refcount and costs zero prefill FLOPs) or misses and
+switches to fresh private pages. On release, a request's full private
+pages are KEYED into the cache (refcount 0, LRU-ordered) rather than
+freed — a later request with the same token prefix (same system prompt,
+same few-shot header, a multi-turn follow-up replaying the conversation)
+reuses them, generated tokens included. The free list refills by evicting
+least-recently-used refcount-0 cached pages on demand.
+
+Page lifecycle:
+
+    free --alloc--> active-private --release(full)--> cached
+      ^                 |release(partial)               |   ^
+      |                 v                        lookup |   | release
+      +--evict-- cached <----- active-shared <----------+---+
+
+A page is EVICTABLE iff refcount 0; keyed pages stay discoverable while
+actively shared, so any number of in-flight slots can share one page.
+
+Immutability invariant (what makes sharing safe): keyed pages are always
+FULL pages strictly before every sharing slot's first private position,
+and the engine only writes at positions >= lengths >= that boundary. An
+evicted page has refcount 0 — no slot's table points at it.
+
+Eviction orphans: evicting a parent page makes cached children
+unreachable (their key embeds the parent's page id); they age out via
+LRU. Correctness is unaffected — lookups simply miss.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+
+
+@dataclasses.dataclass
+class AllocatorStats:
+    pages_total: int
+    pages_free: int
+    pages_cached: int   # refcount-0 keyed pages (evictable)
+    pages_active: int   # referenced by >= 1 slot
+    prefix_hit_pages: int = 0
+    prefix_miss_pages: int = 0
+    evictions: int = 0
+
+
+class BlockAllocator:
+    """Allocator for a pool of `num_pages` device pages of `page_size`
+    tokens. Not thread-safe — callers hold the scheduler lock."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: collections.deque[int] = collections.deque(
+            range(num_pages))
+        self._ref = [0] * num_pages
+        # key -> page for every keyed page (active or not); _evictable
+        # holds ONLY refcount-0 keyed pages, in insertion order — python
+        # dicts iterate oldest-first, giving an O(1) LRU (pages re-insert
+        # on every release, so insertion order IS recency order)
+        self._cache: dict[tuple[int, tuple[int, ...]], int] = {}
+        self._key_of: dict[int, tuple[int, tuple[int, ...]]] = {}
+        self._evictable: dict[int, None] = {}
+        self.prefix_hit_pages = 0
+        self.prefix_miss_pages = 0
+        self.evictions = 0
+
+    # -- capacity -----------------------------------------------------------
+
+    @property
+    def available(self) -> int:
+        """Pages obtainable right now (free + evictable cached)."""
+        return len(self._free) + len(self._evictable)
+
+    def stats(self) -> AllocatorStats:
+        active = self.num_pages - len(self._free) - len(self._evictable)
+        return AllocatorStats(
+            pages_total=self.num_pages, pages_free=len(self._free),
+            pages_cached=len(self._evictable), pages_active=active,
+            prefix_hit_pages=self.prefix_hit_pages,
+            prefix_miss_pages=self.prefix_miss_pages,
+            evictions=self.evictions)
+
+    # -- allocate / share ---------------------------------------------------
+
+    def _evict_one(self) -> None:
+        page = next(iter(self._evictable))  # oldest refcount-0 page
+        del self._evictable[page]
+        del self._cache[self._key_of.pop(page)]
+        self._free.append(page)
+        self.evictions += 1
+
+    def alloc(self, n: int) -> list[int] | None:
+        """n fresh private pages (refcount 1), evicting cached pages as
+        needed; None (and no side effects) if capacity is short."""
+        if self.available < n:
+            return None
+        while len(self._free) < n:
+            self._evict_one()
+        pages = [self._free.popleft() for _ in range(n)]
+        for p in pages:
+            self._ref[p] = 1
+        return pages
+
+    def lookup_prefix(self, prompt: list[int]) -> tuple[list[int], int]:
+        """Walk the prompt's full pages through the prefix cache.
+
+        Returns (shared_pages, shared_len_tokens). Each hit page's
+        refcount is bumped — the caller owns one reference per returned
+        page and must release() them. At least one prompt token is always
+        left un-shared so admission has a position to produce first-token
+        logits from.
+        """
+        ps = self.page_size
+        shared: list[int] = []
+        parent = -1
+        limit = (len(prompt) - 1) // ps  # full pages, leaving >= 1 token
+        for i in range(limit):
+            key = (parent, tuple(prompt[i * ps:(i + 1) * ps]))
+            page = self._cache.get(key)
+            if page is None:
+                self.prefix_miss_pages += 1
+                break
+            self.prefix_hit_pages += 1
+            self._ref[page] += 1
+            self._evictable.pop(page, None)  # active again
+            shared.append(page)
+            parent = page
+        return shared, len(shared) * ps
+
+    # -- release ------------------------------------------------------------
+
+    def release(self, pages: list[int], tokens: list[int]) -> None:
+        """Drop one reference per chain page. Pages reaching refcount 0
+        become cached (if they are full pages covered by `tokens` — the
+        slot's committed prompt + generated ids) or return to the free
+        list (the partial tail)."""
+        ps = self.page_size
+        parent = -1
+        for i, page in enumerate(pages):
+            self._ref[page] -= 1
+            full = (i + 1) * ps <= len(tokens)
+            key = None
+            if full:
+                key = (parent, tuple(tokens[i * ps:(i + 1) * ps]))
+                if page not in self._key_of:
+                    existing = self._cache.get(key)
+                    if existing is None:
+                        self._cache[key] = page
+                        self._key_of[page] = key
+                    # else: duplicate content under another page — leave
+                    # this page unkeyed; it frees below when unreferenced
+            if self._ref[page] <= 0:
+                self._ref[page] = 0
+                if self._key_of.get(page) is not None:
+                    self._evictable[page] = None
+                else:
+                    self._free.append(page)
+            # the canonical page for this chain position (for children's
+            # keys): whatever the cache maps the key to now
+            parent = self._cache.get(key, -1) if key is not None else -1
+            if parent == -1:
+                # chain broken (uncacheable page) — descendants can't be
+                # keyed either; stop keying but keep dropping refs
+                for later in pages[i + 1:]:
+                    self._ref[later] -= 1
+                    if self._ref[later] <= 0:
+                        self._ref[later] = 0
+                        if self._key_of.get(later) is not None:
+                            self._evictable[later] = None
+                        else:
+                            self._free.append(later)
+                return

@@ -1,0 +1,181 @@
+"""Paged inference engine: the window-forward primitive over a page pool.
+
+Where `inference.engine` owns a contiguous (L, B, max_len, KH, Dh) cache,
+this module owns the PAGED cache: one global pool of fixed-size pages per
+layer plus per-slot page tables, so device memory scales with the tokens
+actually resident (not max_slots x max_len) and pages can be SHARED
+between slots (refcounted prefix reuse — inference/block_allocator.py).
+
+Everything the paged server dispatches is one primitive,
+`window_forward(tokens (B, W))`: embed W new positions per slot at
+absolute positions [lengths, lengths + W), write their kv into the pool
+through the page table, and attend each window row against the slot's
+whole paged history (pallas kernel `ops.paged_attention` on TPU, gather +
+dense XLA elsewhere). The server's flows are just widths:
+
+  * plain decode             W = 1
+  * speculative verification W = drafts + 1   (logits="all")
+  * prefill / chunked prefill / prefix-cache continuation: W = chunk,
+    with per-slot start offsets carried by `lengths` (a slot resuming
+    after `n` shared-prefix tokens simply starts at lengths=n)
+
+`window_forward` does NOT advance `lengths` — the caller commits however
+many window positions survive (sampling, speculative acceptance), exactly
+like `engine.verify_step`: stale entries past the commit point are masked
+by `lengths` and overwritten by later writes at the same positions.
+
+Write discipline and sharing safety: a write at absolute position p goes
+to page `tables[b, p // ps]`, offset `p % ps`. The allocator guarantees
+shared (refcount > 1 or cached) pages only ever cover positions < every
+sharing slot's private start, and all writes happen at positions >=
+lengths >= private start — so shared pages are immutable by
+construction. Freed slots get sentinel tables (page id == num_pages):
+their writes drop (`mode="drop"`), which is what makes it safe to keep
+dispatching the full slot batch while some slots are empty.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cloud_server_tpu.config import ModelConfig
+from cloud_server_tpu.inference.engine import _kv_quant, _mlp_apply
+from cloud_server_tpu.models import transformer
+from cloud_server_tpu.ops import rms_norm, rope_table
+from cloud_server_tpu.ops.paged_attention import (
+    paged_attention, paged_attention_xla)
+
+
+class PagedKVCache(NamedTuple):
+    """Page pool + per-slot view. One pool serves every slot and layer."""
+
+    k: jnp.ndarray        # (L, num_pages, KH, ps, Dh) cfg.dtype | int8
+    v: jnp.ndarray        # (L, num_pages, KH, ps, Dh)
+    lengths: jnp.ndarray  # (B,) int32 — committed kv entries per slot
+    tables: jnp.ndarray   # (B, max_pages_per_slot) int32; num_pages = free
+    k_scale: jnp.ndarray | None = None  # (L, num_pages, KH, ps) f32
+    v_scale: jnp.ndarray | None = None
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_context(self) -> int:
+        return self.tables.shape[1] * self.page_size
+
+
+def init_paged_cache(cfg: ModelConfig, *, num_pages: int, page_size: int,
+                     batch: int, max_pages_per_slot: int) -> PagedKVCache:
+    """Zeroed pool; all tables at the sentinel (num_pages = "no page")."""
+    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size,
+             cfg.head_dim)
+    tables = jnp.full((batch, max_pages_per_slot), num_pages, jnp.int32)
+    lengths = jnp.zeros((batch,), jnp.int32)
+    if cfg.kv_cache_dtype == "int8":
+        sshape = shape[:-1]
+        return PagedKVCache(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            lengths=lengths, tables=tables,
+            k_scale=jnp.zeros(sshape, jnp.float32),
+            v_scale=jnp.zeros(sshape, jnp.float32))
+    if cfg.kv_cache_dtype != "model":
+        raise ValueError(f"unknown kv_cache_dtype: {cfg.kv_cache_dtype!r}")
+    dtype = jnp.dtype(cfg.dtype)
+    return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                        lengths=lengths, tables=tables)
+
+
+def hbm_bytes(cache: PagedKVCache) -> int:
+    """Device bytes held by the pool (the capacity comparison the paged
+    layout exists to win — see tests/test_paged_server.py)."""
+    n = cache.k.size * cache.k.dtype.itemsize * 2
+    if cache.k_scale is not None:
+        n += cache.k_scale.size * 4 * 2
+    return n
+
+
+def _write_window(cache: PagedKVCache, layer: int, k, v, pos):
+    """Scatter fresh (B, W, KH, Dh) k/v at absolute positions (B, W)
+    through the page table. Out-of-chain positions (sentinel table
+    entries) drop."""
+    ps = cache.page_size
+    page_slot = jnp.clip(pos // ps, 0, cache.tables.shape[1] - 1)
+    pages = jnp.take_along_axis(cache.tables, page_slot, axis=1)  # (B, W)
+    offs = pos % ps
+    if cache.k_scale is not None:
+        kq, ksc = _kv_quant(k)
+        vq, vsc = _kv_quant(v)
+        return cache._replace(
+            k=cache.k.at[layer, pages, :, offs, :].set(
+                kq.astype(cache.k.dtype), mode="drop"),
+            v=cache.v.at[layer, pages, :, offs, :].set(
+                vq.astype(cache.v.dtype), mode="drop"),
+            k_scale=cache.k_scale.at[layer, pages, :, offs].set(
+                ksc[..., 0], mode="drop"),
+            v_scale=cache.v_scale.at[layer, pages, :, offs].set(
+                vsc[..., 0], mode="drop"))
+    return cache._replace(
+        k=cache.k.at[layer, pages, :, offs, :].set(
+            k.astype(cache.k.dtype), mode="drop"),
+        v=cache.v.at[layer, pages, :, offs, :].set(
+            v.astype(cache.v.dtype), mode="drop"))
+
+
+def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
+                   cache: PagedKVCache, *, logits_at: jnp.ndarray | None,
+                   all_logits: bool = False,
+                   pages_per_block: int = 4):
+    """Forward W new positions per slot against the paged cache.
+
+    Args:
+      tokens: (B, W) int32 — slot b's tokens for absolute positions
+        [lengths[b], lengths[b] + W). Pad rows/slots freely: writes
+        through sentinel tables drop, outputs are masked by the caller.
+      logits_at: (B,) int32 in-window indices — return logits only at
+        that position per slot ((B, V) f32); the chunked-prefill path
+        needs one sampled position per chunk, never the (B, W, V) tensor.
+      all_logits: return (B, W, V) f32 (speculative verification).
+        With neither, returns None (interior prefill chunks).
+
+    Returns (logits, cache') — cache' has the window written but lengths
+    UNCHANGED (see module docstring).
+    """
+    b, w = tokens.shape
+    pos = cache.lengths[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    cos, sin = rope_table(cfg, cache.max_context)
+    x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]  # (B, W, D)
+
+    use_pallas = cfg.decode_attention_impl == "pallas"
+    lens_after = cache.lengths + w
+
+    for layer_idx in range(cfg.num_layers):
+        lp = jax.tree.map(lambda p: p[layer_idx], params["layers"])
+        q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin, pos)
+        cache = _write_window(cache, layer_idx, k, v, pos)
+        if use_pallas:
+            o = paged_attention(
+                q, cache.k, cache.v, lens_after, cache.tables, layer_idx,
+                pages_per_block=pages_per_block,
+                k_scale_pool=cache.k_scale, v_scale_pool=cache.v_scale)
+        else:
+            o = paged_attention_xla(
+                q, cache.k, cache.v, lens_after, cache.tables, layer_idx,
+                k_scale_pool=cache.k_scale, v_scale_pool=cache.v_scale)
+        x = transformer.attention_out(x, o, lp, cfg)
+        x = _mlp_apply(x, lp, cfg)
+
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    if all_logits:
+        return transformer.unembed(x, params, cfg), cache
+    if logits_at is not None:
+        x_sel = x[jnp.arange(b), jnp.clip(logits_at, 0, w - 1)]  # (B, D)
+        return transformer.unembed(x_sel, params, cfg), cache
+    return None, cache

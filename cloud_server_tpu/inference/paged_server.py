@@ -1,0 +1,683 @@
+"""Paged continuous-batching server: block-table KV, shared prefixes,
+chunked prefill, and in-server speculative decoding.
+
+This is the successor of `inference.server.InferenceServer` (which keeps
+the contiguous slot cache). What the paged design buys:
+
+  * Memory scales with resident tokens, not max_slots x max_len: the pool
+    is `num_pages` fixed-size pages; a slot holds ceil(context / ps)
+    pages. More concurrent requests fit in the same HBM whenever requests
+    are shorter than max_context or share prefixes.
+  * Prefix reuse is GENERAL (radix-style, page granularity): any request
+    whose token prefix matches cached pages — same system prompt, same
+    few-shot header, a multi-turn follow-up replaying the conversation
+    (generated tokens included) — skips prefill for the shared pages.
+    No server-lifetime single prefix; the cache is learned from traffic
+    and LRU-evicted under memory pressure (inference/block_allocator.py).
+  * Chunked prefill: admissions run as a sequence of bounded window
+    dispatches (`prefill_chunk` tokens each) interleaved with decode
+    steps, so one long prompt never stalls active decodes for its whole
+    prefill — inter-token latency stays bounded (the serving bench
+    measures it).
+  * Speculative decoding IS the decode loop (spec_drafts > 0): per-slot
+    n-gram proposals drafted on device from each slot's token history,
+    verified batch-wide in one W = drafts+1 window, committed per slot
+    with the exact accept/residual rule (`speculative._accept_point_mass`
+    — output distribution provably unchanged; token-for-token greedy).
+    No draft model, no extra memory; repetition-heavy decodes commit
+    several tokens per model pass.
+
+Scheduling state is HOST-authoritative (tables, lengths, active,
+last_token live in numpy and ride into each dispatch as small inputs);
+the device owns only the big buffers (page pools + per-slot token
+history), donated through every dispatch. One device_get per scheduler
+iteration, amortised over `decode_chunk` (speculative) rounds
+(multi-token scheduling, as in the contiguous server).
+
+Write-safety rules the scheduler maintains (see paged_engine for why
+writes through sentinel tables drop):
+  * decode dispatches get SENTINEL table rows for every non-live slot, so
+    a slot mid-admission can never have its freshly prefilled pages
+    clobbered by the concurrent batch-wide decode window;
+  * page chains are fully reserved at admission (prompt + max_new +
+    window slack), so decode never outgrows its chain and there is no
+    mid-flight OOM/preemption path.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from cloud_server_tpu.config import InferConfig, ModelConfig
+from cloud_server_tpu.inference import paged_engine
+from cloud_server_tpu.inference.block_allocator import BlockAllocator
+from cloud_server_tpu.inference.sampling import sample_logits, sampling_probs
+from cloud_server_tpu.inference.server import (
+    Request, _bucket, _token_logprobs)
+from cloud_server_tpu.inference.speculative import (
+    _accept_point_mass, _ngram_drafts)
+
+
+def _pow2_buckets(lo: int, hi: int) -> list[int]:
+    out, b = [], lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    return out + [hi]
+
+
+def _pad_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Jitted dispatches (module-level so compiles are shared across servers)
+# ---------------------------------------------------------------------------
+
+
+def _make_cache(pools, lengths, tables):
+    return paged_engine.PagedKVCache(
+        k=pools["k"], v=pools["v"], lengths=lengths, tables=tables,
+        k_scale=pools.get("k_scale"), v_scale=pools.get("v_scale"))
+
+
+def _split_cache(cache):
+    pools = {"k": cache.k, "v": cache.v}
+    if cache.k_scale is not None:
+        pools["k_scale"] = cache.k_scale
+        pools["v_scale"] = cache.v_scale
+    return pools
+
+
+@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "scatter_prompt"),
+         donate_argnums=(1,))
+def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
+                   slot_ids, prompt_rows, prompt_lens, rng, *,
+                   cfg: ModelConfig, infer_cfg: InferConfig,
+                   scatter_prompt: bool):
+    """One admission chunk for a (padded) G-row group.
+
+    chunk: (G, Wc) tokens for positions [g_lens, g_lens + Wc) per row —
+    rows at different offsets, which is how shared prefixes resume deeper
+    and how successive chunks continue. sample_at: in-window index of
+    each row's LAST true prompt token (clamped; the caller keeps the
+    sample only when it truly falls inside this chunk). On the first
+    chunk (`scatter_prompt`) each row's full prompt is written into its
+    slot's device history for n-gram drafting. Padding rows carry
+    slot_id == max_slots and sentinel tables: every scatter drops.
+
+    Returns (state', first-token candidates (G,), their logprobs (G,)).
+    """
+    cache = _make_cache(state["pools"], g_lens, g_tables)
+    logits, cache = paged_engine.window_forward(
+        params, chunk, cfg, cache, logits_at=sample_at)
+    toks = sample_logits(logits, rng, infer_cfg)
+    lps = _token_logprobs(logits, toks)
+    hist = state["hist"]
+    if scatter_prompt:
+        pb = prompt_rows.shape[1]
+        cols = jnp.broadcast_to(jnp.arange(pb)[None, :], prompt_rows.shape)
+        cols = jnp.where(cols < prompt_lens[:, None], cols, hist.shape[1])
+        hist = hist.at[slot_ids[:, None], cols].set(prompt_rows,
+                                                    mode="drop")
+    return {"pools": _split_cache(cache), "hist": hist}, toks, lps
+
+
+@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "n_rounds"),
+         donate_argnums=(1,))
+def _decode_rounds(params, state, lengths, tables, last_token, live,
+                   rng, *, cfg: ModelConfig, infer_cfg: InferConfig,
+                   n_rounds: int):
+    """n_rounds plain decode steps (W=1) in one dispatch (lax.scan).
+
+    `live` slots advance one token per round; the rest are frozen (their
+    writes drop through the sentinel tables the caller passes).
+
+    Returns (state', lengths', last', (toks (R, B), lps (R, B),
+    counts (R, B) int32)).
+    """
+    pad = infer_cfg.pad_token_id
+    batch_idx = jnp.arange(lengths.shape[0])
+
+    def body(carry, rng_t):
+        lengths, last, hist, pools = carry
+        # `last` is the committed token at sequence position `lengths`
+        # (this round writes its kv there); record it in the history so
+        # drafting/multi-turn reads see an unbroken token sequence
+        cols = jnp.where(live, lengths, hist.shape[1])
+        hist = hist.at[batch_idx, cols].set(last, mode="drop")
+        cache = _make_cache(pools, lengths, tables)
+        logits, cache = paged_engine.window_forward(
+            params, last[:, None], cfg, cache,
+            logits_at=jnp.zeros_like(lengths))
+        tok = sample_logits(logits, rng_t, infer_cfg)
+        lp = _token_logprobs(logits, tok)
+        tok = jnp.where(live, tok, pad)
+        new_len = jnp.where(live, lengths + 1, lengths)
+        last = jnp.where(live, tok, last)
+        return ((new_len, last, hist, _split_cache(cache)),
+                (tok, lp, live.astype(jnp.int32)))
+
+    (lengths, last, hist, pools), out = lax.scan(
+        body, (lengths, last_token, state["hist"], state["pools"]),
+        jax.random.split(rng, n_rounds))
+    return {"pools": pools, "hist": hist}, lengths, last, out
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "infer_cfg", "n_rounds", "n_drafts"),
+         donate_argnums=(1,))
+def _spec_rounds(params, state, lengths, tables, last_token, live,
+                 stop_len, rng, *, cfg: ModelConfig, infer_cfg: InferConfig,
+                 n_rounds: int, n_drafts: int):
+    """n_rounds speculative rounds in one dispatch.
+
+    Each round drafts `n_drafts` tokens per slot from its device-resident
+    history (prompt-lookup n-grams), scores the (drafts+1)-token window in
+    ONE batched window_forward, and commits each slot's accepted prefix
+    plus the corrective/bonus token (exact accept rule). Commits are
+    capped at stop_len so a slot never outruns its page chain.
+
+    Returns (state', lengths', last',
+    (toks (R, B, G+1), lps (R, B, G+1), counts (R, B))).
+    """
+    g = n_drafts
+    b = lengths.shape[0]
+    pad = infer_cfg.pad_token_id
+    batch_idx = jnp.arange(b)
+    j = jnp.arange(g + 1)[None, :]
+
+    def body(carry, rng_t):
+        lengths, last, hist, pools = carry
+        rng_acc, _ = jax.random.split(rng_t)
+        can_commit = live & (lengths < stop_len)
+
+        # `last` is the committed token at sequence position `lengths`;
+        # write it into the history BEFORE drafting so bigram lookups
+        # spanning the prompt/generated boundary see the true sequence
+        cols_last = jnp.where(live, lengths, hist.shape[1])
+        hist = hist.at[batch_idx, cols_last].set(last, mode="drop")
+        valid = lengths + 1  # committed tokens = [0, lengths] incl. last
+        t_prev2 = hist[batch_idx, jnp.maximum(valid - 2, 0)]
+        drafts = _ngram_drafts(hist, valid, t_prev2, last, g, pad)
+        window = jnp.concatenate([last[:, None], drafts], axis=1)
+
+        cache = _make_cache(pools, lengths, tables)
+        vlogits, cache = paged_engine.window_forward(
+            params, window, cfg, cache, logits_at=None, all_logits=True)
+        p_probs = sampling_probs(vlogits, infer_cfg)  # (B, G+1, V)
+        n_acc, x = _accept_point_mass(drafts, p_probs, rng_acc)
+
+        drafts_x = jnp.concatenate([drafts, x[:, None]], axis=1)
+        committed = jnp.where(j < n_acc[:, None], drafts_x,
+                              jnp.where(j == n_acc[:, None],
+                                        x[:, None], pad))
+        count = jnp.where(can_commit, n_acc + 1, 0)
+        count = jnp.minimum(count, jnp.maximum(stop_len - lengths, 0))
+        toks = jnp.where(j < count[:, None], committed, pad)
+        # log P(tok) under the raw target distribution at each window
+        # position (position i's logits score the token committed there)
+        lps = jnp.take_along_axis(
+            jax.nn.log_softmax(vlogits, axis=-1),
+            jnp.maximum(toks, 0)[..., None], axis=-1)[..., 0]
+
+        new_len = lengths + count
+        # committed[j] is the token at sequence position lengths + 1 + j
+        # (position `lengths` holds `last`, written above)
+        cols = (lengths + 1)[:, None] + j
+        cols = jnp.where(j < count[:, None], cols, hist.shape[1])
+        hist = hist.at[batch_idx[:, None], cols].set(toks, mode="drop")
+        last_idx = jnp.maximum(count - 1, 0)
+        last2 = jnp.where(count > 0, committed[batch_idx, last_idx], last)
+        return ((new_len, last2, hist, _split_cache(cache)),
+                (toks, lps, count))
+
+    (lengths, last, hist, pools), out = lax.scan(
+        body, (lengths, last_token, state["hist"], state["pools"]),
+        jax.random.split(rng, n_rounds))
+    return {"pools": pools, "hist": hist}, lengths, last, out
+
+
+# ---------------------------------------------------------------------------
+# Host-side scheduler
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Request
+    prompt: list[int]
+    pages: list[int]            # full chain, shared prefix first
+    shared_len: int
+    stop_len: int               # prompt + max_new (absolute positions)
+
+
+@dataclasses.dataclass
+class _AdmitJob:
+    """An in-flight chunked admission: one bucketed group of slots."""
+
+    slots: list[int]
+    chunk_w: int
+    n_chunks: int
+    rows: np.ndarray               # (G, n_chunks*chunk_w) remainder tokens
+    rem_lens: np.ndarray           # (G,) true remainder lengths
+    base_lens: np.ndarray          # (G,) shared_len per row
+    prompt_rows: np.ndarray        # (G, prompt_bucket)
+    prompt_lens: np.ndarray        # (G,)
+    toks: np.ndarray               # captured first-token candidates
+    lps: np.ndarray
+    got: np.ndarray                # bool — sample captured yet
+    next_chunk: int = 0
+
+
+class PagedInferenceServer:
+    """Continuous-batching server over the paged KV cache.
+
+    Same client API as `InferenceServer` (submit / generate / step /
+    start / stop / run_until_idle); see the module docstring for what
+    changes inside.
+    """
+
+    def __init__(self, params, cfg: ModelConfig, infer_cfg: InferConfig, *,
+                 max_slots: int = 8, max_context: int = 1024,
+                 page_size: int = 64, num_pages: int | None = None,
+                 prompt_buckets: Sequence[int] | None = None,
+                 decode_chunk: int = 8, spec_drafts: int = 0,
+                 prefill_chunk: int = 256, seed: int = 0):
+        from cloud_server_tpu.models.quantization import QTensor
+        target = jnp.dtype(cfg.dtype)
+
+        def cast_leaf(w):
+            if isinstance(w, QTensor):
+                return w
+            if getattr(w, "dtype", None) == jnp.float32 and w.ndim >= 1:
+                return w.astype(target)
+            return w
+
+        self.params = jax.tree.map(
+            cast_leaf, params, is_leaf=lambda x: isinstance(x, QTensor))
+        self.cfg = cfg
+        self.infer_cfg = infer_cfg
+        self.max_slots = max_slots
+        self.page_size = page_size
+        self.spec_drafts = spec_drafts
+        self.decode_chunk = max(1, decode_chunk)
+        self.window = spec_drafts + 1  # kv slack per decode round
+        if max_context % page_size:
+            raise ValueError(f"{max_context=} must be a multiple of "
+                             f"{page_size=}")
+        self.max_context = max_context
+        self.max_pages_per_slot = max_context // page_size
+        if num_pages is None:
+            # default: the same HBM the contiguous layout would reserve
+            num_pages = max_slots * self.max_pages_per_slot
+        self.allocator = BlockAllocator(num_pages, page_size)
+        self.prefill_chunk = max(page_size, min(prefill_chunk, max_context))
+        if self.prefill_chunk % page_size:
+            raise ValueError("prefill_chunk must be a page multiple")
+        if prompt_buckets is None:
+            prompt_buckets = _pow2_buckets(16, max_context)
+        self.prompt_buckets = sorted(prompt_buckets)
+        # remainders bucket to a pow2 <= prefill_chunk (single-chunk jobs)
+        # or a prefill_chunk multiple (multi-chunk jobs) — chunk WIDTHS
+        # stay a small fixed set, chunk COUNTS are host-side loops
+        self._rem_buckets = _pow2_buckets(16, self.prefill_chunk)
+
+        cache = paged_engine.init_paged_cache(
+            cfg, num_pages=num_pages, page_size=page_size, batch=max_slots,
+            max_pages_per_slot=self.max_pages_per_slot)
+        self.state = {
+            "pools": _split_cache(cache),
+            "hist": jnp.zeros((max_slots, max_context), jnp.int32),
+        }
+        # host-authoritative scheduling state
+        self.tables = np.full((max_slots, self.max_pages_per_slot),
+                              num_pages, np.int32)
+        self.lengths = np.zeros((max_slots,), np.int32)
+        self.active = np.zeros((max_slots,), bool)
+        self.last_token = np.zeros((max_slots,), np.int32)
+        self.stop_len = np.zeros((max_slots,), np.int32)
+
+        # speculative-efficiency counters: committed tokens per model
+        # round (mean accepted length + 1); plain decode reports ~1.0
+        self.decode_rounds = 0
+        self.decode_tokens_committed = 0
+
+        self._slots: list[_Slot | None] = [None] * max_slots
+        self._jobs: list[_AdmitJob] = []
+        self._pending: collections.deque[Request] = collections.deque()
+        self._lock = threading.Lock()
+        self._step_lock = threading.Lock()
+        self._rng = jax.random.key(seed)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- client API ---------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: int | None = None, stream=None) -> Request:
+        if self._stop.is_set():
+            raise RuntimeError("server is stopped; not accepting requests")
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        _bucket(len(prompt), self.prompt_buckets)  # raises if too long
+        max_new = (self.infer_cfg.max_decode_len if max_new_tokens is None
+                   else max_new_tokens)
+        # leave room for the last speculative window's writes
+        max_new = min(max_new, self.max_context - len(prompt) - self.window)
+        if max_new <= 0:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens leaves no room to decode "
+                f"within max_context={self.max_context}")
+        req = Request(prompt=list(prompt), max_new_tokens=max_new,
+                      stream=stream)
+        with self._lock:
+            self._pending.append(req)
+        return req
+
+    def generate(self, prompts, *, max_new_tokens=None):
+        reqs = [self.submit(p, max_new_tokens=max_new_tokens)
+                for p in prompts]
+        self.run_until_idle()
+        return [r.tokens for r in reqs]
+
+    @property
+    def num_active(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def prefix_cache_stats(self):
+        return self.allocator.stats()
+
+    # -- internals ----------------------------------------------------------
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _emit(self, req: Request, token: int, logprob: float) -> bool:
+        if token == self.infer_cfg.eos_token_id:
+            req.finish_reason = "eos"
+            return True
+        req.tokens.append(token)
+        req.logprobs.append(float(logprob))
+        if req.stream is not None:
+            req.stream(token)
+        if len(req.tokens) >= req.max_new_tokens:
+            req.finish_reason = "length"
+            return True
+        return False
+
+    def _finish(self, slot_id: int) -> None:
+        slot = self._slots[slot_id]
+        committed = slot.prompt + slot.req.tokens
+        self.allocator.release(slot.pages, committed)
+        self._slots[slot_id] = None
+        self.tables[slot_id, :] = self.allocator.num_pages  # sentinel
+        self.active[slot_id] = False
+        self.lengths[slot_id] = 0
+        slot.req._done.set()
+
+    # -- admission ----------------------------------------------------------
+
+    def _rem_bucket(self, rem: int) -> int:
+        if rem <= self.prefill_chunk:
+            return _bucket(rem, self._rem_buckets)
+        return -(-rem // self.prefill_chunk) * self.prefill_chunk
+
+    def _start_admissions(self) -> None:
+        """Pop pending requests into slots (pages permitting) and build
+        bucketed chunked-prefill jobs."""
+        staged: list[int] = []
+        with self._lock:
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            while self._pending and free:
+                req = self._pending[0]
+                shared, shared_len = self.allocator.lookup_prefix(req.prompt)
+                total = len(req.prompt) + req.max_new_tokens + self.window
+                need = -(-total // self.page_size) - len(shared)
+                fresh = self.allocator.alloc(max(0, need))
+                if fresh is None:
+                    self.allocator.release(shared, req.prompt[:shared_len])
+                    if self.num_active == 0 and not self._jobs:
+                        # nothing running will ever free pages: the pool
+                        # is simply too small for this request
+                        self._pending.popleft()
+                        req.finish_reason = (
+                            "error: request needs more pages than the "
+                            "pool can ever provide")
+                        req._done.set()
+                        continue
+                    break
+                self._pending.popleft()
+                slot_id = free.pop(0)
+                slot = _Slot(req=req, prompt=list(req.prompt),
+                             pages=shared + fresh, shared_len=shared_len,
+                             stop_len=len(req.prompt) + req.max_new_tokens)
+                self._slots[slot_id] = slot
+                self.tables[slot_id, :] = self.allocator.num_pages
+                self.tables[slot_id, :len(slot.pages)] = slot.pages
+                self.lengths[slot_id] = shared_len
+                self.stop_len[slot_id] = slot.stop_len
+                self.active[slot_id] = False  # live once admission is done
+                staged.append(slot_id)
+        if not staged:
+            return
+        # group by remainder bucket => uniform chunk schedule per job
+        by_bucket: dict[int, list[int]] = {}
+        for slot_id in staged:
+            slot = self._slots[slot_id]
+            rb = self._rem_bucket(len(slot.prompt) - slot.shared_len)
+            by_bucket.setdefault(rb, []).append(slot_id)
+        pad_tok = self.infer_cfg.pad_token_id
+        for rb, slot_ids in by_bucket.items():
+            w = min(rb, self.prefill_chunk)
+            n_chunks = -(-rb // w)
+            g = len(slot_ids)
+            pb = _bucket(max(len(self._slots[s].prompt) for s in slot_ids),
+                         self.prompt_buckets)
+            job = _AdmitJob(
+                slots=list(slot_ids), chunk_w=w, n_chunks=n_chunks,
+                rows=np.full((g, n_chunks * w), pad_tok, np.int32),
+                rem_lens=np.zeros((g,), np.int32),
+                base_lens=np.zeros((g,), np.int32),
+                prompt_rows=np.full((g, pb), pad_tok, np.int32),
+                prompt_lens=np.zeros((g,), np.int32),
+                toks=np.zeros((g,), np.int32),
+                lps=np.zeros((g,), np.float64),
+                got=np.zeros((g,), bool))
+            for i, sid in enumerate(slot_ids):
+                slot = self._slots[sid]
+                rem_toks = slot.prompt[slot.shared_len:]
+                job.rows[i, :len(rem_toks)] = rem_toks
+                job.rem_lens[i] = len(rem_toks)
+                job.base_lens[i] = slot.shared_len
+                job.prompt_rows[i, :len(slot.prompt)] = slot.prompt
+                job.prompt_lens[i] = len(slot.prompt)
+            self._jobs.append(job)
+
+    def _run_one_chunk(self, job: _AdmitJob) -> None:
+        c = job.next_chunk
+        w = job.chunk_w
+        g = len(job.slots)
+        gp = _pad_pow2(g)  # bound compiles: group rows pad to a power of 2
+
+        def pad_rows(a, fill):
+            if g == gp:
+                return a
+            padded = np.full((gp,) + a.shape[1:], fill, a.dtype)
+            padded[:g] = a
+            return padded
+
+        chunk = pad_rows(job.rows[:, c * w:(c + 1) * w],
+                         self.infer_cfg.pad_token_id)
+        g_lens = pad_rows(job.base_lens + c * w, 0)
+        slot_ids = pad_rows(np.asarray(job.slots, np.int32), self.max_slots)
+        g_tables = np.full((gp, self.max_pages_per_slot),
+                           self.allocator.num_pages, np.int32)
+        g_tables[:g] = self.tables[np.asarray(job.slots)]
+        sample_at = pad_rows(np.clip(job.rem_lens - 1 - c * w, 0, w - 1), 0)
+        in_range = ((job.rem_lens - 1) >= c * w) & (
+            (job.rem_lens - 1) < (c + 1) * w)
+        prompt_rows = pad_rows(job.prompt_rows, self.infer_cfg.pad_token_id)
+        prompt_lens = pad_rows(job.prompt_lens, 0)
+
+        self.state, toks, lps = _prefill_chunk(
+            self.params, self.state, jnp.asarray(chunk),
+            jnp.asarray(g_lens, jnp.int32), jnp.asarray(g_tables),
+            jnp.asarray(sample_at, jnp.int32), jnp.asarray(slot_ids),
+            jnp.asarray(prompt_rows), jnp.asarray(prompt_lens, jnp.int32),
+            self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg,
+            scatter_prompt=(c == 0))
+        toks, lps = jax.device_get((toks, lps))
+        toks, lps = np.asarray(toks)[:g], np.asarray(lps)[:g]
+        job.toks = np.where(in_range, toks, job.toks)
+        job.lps = np.where(in_range, lps, job.lps)
+        job.got |= in_range
+        job.next_chunk += 1
+
+        if job.next_chunk >= job.n_chunks:
+            # admission complete: activate slots, emit first tokens
+            for i, sid in enumerate(job.slots):
+                slot = self._slots[sid]
+                assert bool(job.got[i]), "first-token sample never captured"
+                self.lengths[sid] = len(slot.prompt)
+                self.last_token[sid] = int(job.toks[i])
+                self.active[sid] = True
+                if self._emit(slot.req, int(job.toks[i]),
+                              float(job.lps[i])):
+                    self._finish(sid)
+            self._jobs.remove(job)
+
+    # -- decode -------------------------------------------------------------
+
+    def _chunk_rounds(self) -> int:
+        """Rounds this dispatch: bounded by decode_chunk and the tightest
+        remaining budget (in rounds), rounded down to a power of two."""
+        rem = [s.req.max_new_tokens - len(s.req.tokens)
+               for i, s in enumerate(self._slots)
+               if s is not None and self.active[i]]
+        if not rem:
+            return 1
+        n = max(1, min(self.decode_chunk, -(-min(rem) // self.window)))
+        p = 1
+        while p * 2 <= n:
+            p *= 2
+        return p
+
+    def _decode_dispatch(self) -> None:
+        n = self._chunk_rounds()
+        live = self.active.copy()
+        # non-live slots (mid-admission or empty) must not write through
+        # their real tables — the batch-wide window would clobber pages
+        # their prefill chunks are filling
+        masked_tables = np.where(live[:, None], self.tables,
+                                 self.allocator.num_pages)
+        args = (jnp.asarray(self.lengths), jnp.asarray(masked_tables),
+                jnp.asarray(self.last_token), jnp.asarray(live))
+        if self.spec_drafts > 0:
+            self.state, lens, last, (toks, lps, counts) = _spec_rounds(
+                self.params, self.state, *args,
+                jnp.asarray(self.stop_len), self._next_rng(),
+                cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
+                n_drafts=self.spec_drafts)
+            toks, lps, counts, lens, last = jax.device_get(
+                (toks, lps, counts, lens, last))
+        else:
+            self.state, lens, last, (toks, lps, counts) = _decode_rounds(
+                self.params, self.state, *args, self._next_rng(),
+                cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n)
+            toks, lps, counts, lens, last = jax.device_get(
+                (toks, lps, counts, lens, last))
+            toks, lps = toks[:, :, None], lps[:, :, None]
+
+        self.lengths = np.asarray(lens).copy()
+        self.last_token = np.asarray(last).copy()
+        counts = np.asarray(counts)
+        n_live = int(live.sum())
+        self.decode_rounds += int(counts.shape[0]) * n_live
+        self.decode_tokens_committed += int(counts.sum())
+        for r in range(toks.shape[0]):
+            for sid in range(self.max_slots):
+                slot = self._slots[sid]
+                if slot is None or not self.active[sid]:
+                    continue
+                for t in range(int(counts[r, sid])):
+                    if self._emit(slot.req, int(toks[r, sid, t]),
+                                  float(lps[r, sid, t])):
+                        self._finish(sid)
+                        break
+
+    # -- scheduler ----------------------------------------------------------
+
+    def step(self) -> int:
+        """One scheduler iteration: start admissions, run ONE prefill
+        chunk per in-flight admission job (chunked prefill interleaving),
+        then one decode dispatch. Thread-safe."""
+        with self._step_lock:
+            self._start_admissions()
+            for job in list(self._jobs):
+                self._run_one_chunk(job)
+            if self.active.any():
+                self._decode_dispatch()
+            return self.num_active
+
+    def run_until_idle(self) -> None:
+        while self.num_pending or self.num_active or self._jobs:
+            self.step()
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._lock:
+            pending, self._pending = list(self._pending), collections.deque()
+        for sid, slot in enumerate(self._slots):
+            if slot is not None:
+                slot.req.finish_reason = f"error: {exc!r}"
+                slot.req._done.set()
+                self._slots[sid] = None
+        self._jobs.clear()
+        for req in pending:
+            req.finish_reason = f"error: {exc!r}"
+            req._done.set()
+
+    def serve_forever(self, idle_sleep_s: float = 0.002) -> None:
+        while not self._stop.is_set():
+            try:
+                busy = self.step()
+            except Exception as exc:  # noqa: BLE001 — must not hang clients
+                import traceback
+                traceback.print_exc()
+                self._fail_all(exc)
+                self._stop.set()
+                return
+            if busy == 0 and self.num_pending == 0 and not self._jobs:
+                self._stop.wait(idle_sleep_s)
+
+    def start(self) -> "PagedInferenceServer":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True,
+                                        name="paged-inference-server")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None

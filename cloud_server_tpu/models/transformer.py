@@ -206,7 +206,8 @@ def _get_attention_fn(cfg: ModelConfig):
         return causal_attention
     if cfg.attention_impl == "flash":
         from cloud_server_tpu.ops.flash_attention import flash_attention
-        return flash_attention
+        return partial(flash_attention, block_q=cfg.flash_block_q,
+                       block_kv=cfg.flash_block_kv)
     if cfg.attention_impl == "ring":
         from cloud_server_tpu.parallel.mesh import current_mesh
         from cloud_server_tpu.parallel.ring_attention import (
@@ -240,7 +241,9 @@ def _packed_attention_fn(cfg: ModelConfig, segment_ids):
         return partial(causal_attention, segment_ids=segment_ids)
     if cfg.attention_impl == "flash":
         from cloud_server_tpu.ops.flash_attention import flash_attention
-        return partial(flash_attention, segment_ids=segment_ids)
+        return partial(flash_attention, segment_ids=segment_ids,
+                       block_q=cfg.flash_block_q,
+                       block_kv=cfg.flash_block_kv)
     raise ValueError(
         f"packed segment_ids support requires attention_impl 'xla' or "
         f"'flash' (got {cfg.attention_impl!r}); the ring/ulysses "

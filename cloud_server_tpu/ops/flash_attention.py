@@ -9,10 +9,13 @@ core, which is what makes carrying scratch across grid steps sound.
 GQA is handled in the index maps: kv blocks for q-head h come from kv-head
 h // (H // KH); no materialised repeat of k/v.
 
-The backward pass recomputes p blockwise (flash style) with a
-(batch, heads, kv_blocks, q_blocks) grid — kv-stationary so dk/dv accumulate
-in scratch; dq is accumulated into its output block across the inner q loop
-revisits... (dq uses q-stationary accumulation via a second kernel).
+The backward pass recomputes p blockwise (flash style) in ONE
+kv-stationary (batch, heads, kv_blocks, q_blocks) pass that yields dk/dv
+(scratch-accumulated) and per-kv-block dq partials (summed by XLA
+outside). Sequences that fit one block skip the staging entirely via a
+fused whole-sequence kernel. Blocked + single recompute is what lets
+block sizes shrink to where the causal block skip pays (a lone S-sized
+block computes the full S x S square, twice the needed FLOPs).
 
 On non-TPU backends (tests), `interpret=True` runs the same kernels through
 the pallas interpreter so numerics are verified on CPU.
@@ -200,17 +203,37 @@ def _vmem(shape, dtype):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
-                     scale, block_q, block_kv, has_seg):
+                     scale, block_q, block_kv, has_seg, stage_dq):
+    """kv-stationary backward producing dk, dv and (stage_dq) per-kv-block
+    dq partials in ONE pass. s/p are recomputed once per (j, i) block
+    pair — the two-pass layout runs a second q-stationary kernel for dq,
+    paying the whole recompute twice. dq partials land in a (nkv, ...)
+    staging array (each (j, i) cell owns one block; summed over nkv by
+    XLA afterwards), costing nkv x q-bytes of f32 HBM to remove a full
+    blockwise recompute pass — the dominant bwd cost at the bench shapes.
+    For long sequences (nkv > _DQ_STAGE_MAX_NKV) that staging memory
+    grows quadratically in S, so stage_dq=False restores the two-pass
+    path."""
     if has_seg:
-        sq_ref, skv_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+        sq_ref, skv_ref, dk_ref, dv_ref, *rest = refs
     else:
-        dk_ref, dv_ref, dk_acc, dv_acc = refs
+        dk_ref, dv_ref, *rest = refs
+    if stage_dq:
+        dqp_ref, dk_acc, dv_acc = rest
+    else:
+        dqp_ref = None
+        dk_acc, dv_acc = rest
     j, i = pl.program_id(2), pl.program_id(3)  # kv-stationary: q innermost
 
     @pl.when(i == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    if stage_dq:
+        # every (j, i) cell owns its dq-partial block — cells skipped by
+        # the causal guard must still zero it
+        dqp_ref[0, 0, 0] = jnp.zeros_like(dqp_ref[0, 0, 0])
 
     @pl.when(i * block_q + block_q - 1 >= j * block_kv)
     def _compute():
@@ -238,6 +261,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
         dp = _dot(do, v, ((1,), (1,)))
         ds = p * (dp - delta) * scale
         dk_acc[:] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))
+        if stage_dq:
+            dqp_ref[0, 0, 0] = _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
     @pl.when(i == pl.num_programs(3) - 1)
     def _finalize():
@@ -281,6 +306,11 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
                    scale, block_q, block_kv, has_seg):
+    """q-stationary dq pass — the LONG-SEQUENCE fallback. The single-pass
+    kernel above stages dq partials in a (nkv, ...) f32 array whose nkv
+    factor grows linearly with S (quadratic total HBM); past
+    _DQ_STAGE_MAX_NKV the old two-pass layout (second recompute, O(S)
+    memory) is the right trade."""
     if has_seg:
         sq_ref, skv_ref, dq_ref, dq_acc = refs
     else:
@@ -320,6 +350,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
+# Largest kv-block count for which the single-pass backward may stage dq
+# partials ((b, h, nkv, sq, d) f32 — nkv x dq-bytes of HBM). Above this,
+# dq runs as its own q-stationary pass instead.
+_DQ_STAGE_MAX_NKV = 8
+
+
 # ---------------------------------------------------------------------------
 # custom_vjp wrapper
 # ---------------------------------------------------------------------------
@@ -349,32 +385,14 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
                                 scale=scale, interpret=interpret)
 
     nq, nkv = pl.cdiv(sq, block_q), pl.cdiv(skv, block_kv)
-
-    q_spec_qs = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0))
-    kv_spec_qs = pl.BlockSpec((1, 1, block_kv, d),
-                              lambda bi, hi, i, j: (bi, hi // g, j, 0))
-    lse_spec_qs = pl.BlockSpec((1, 1, block_q, LSE_LANES),
-                               lambda bi, hi, i, j: (bi, hi, i, 0))
     has_seg = segment_ids is not None
     seg_inputs = list(_seg_views(segment_ids)) if has_seg else []
+    stage_dq = nkv <= _DQ_STAGE_MAX_NKV
 
-    dq_in_specs = [q_spec_qs, kv_spec_qs, kv_spec_qs, q_spec_qs, lse_spec_qs,
-                   q_spec_qs]
-    if has_seg:
-        dq_in_specs.extend(_seg_specs(block_q, block_kv))
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
-                          block_kv=block_kv, has_seg=has_seg),
-        grid=(b, h, nq, nkv),
-        in_specs=dq_in_specs,
-        out_specs=q_spec_qs,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[_vmem((block_q, d), jnp.float32)],
-        interpret=interpret,
-    )(q, k, v, out, lse, do, *seg_inputs)
-
-    # kv-stationary grid for dk/dv: one pass per (kv block), q innermost.
-    # Outputs are per *q-head*; sum over the group afterwards for GQA.
+    # ONE kv-stationary pass produces dk, dv and (for bounded nkv)
+    # per-kv-block dq partials (q innermost so dk/dv accumulate in
+    # scratch). Outputs are per *q-head*; dk/dv sum over the GQA group
+    # afterwards, dq sums over its nkv staging axis.
     q_spec_ks = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, j, i: (bi, hi, i, 0))
     kv_spec_ks = pl.BlockSpec((1, 1, block_kv, d),
                               lambda bi, hi, j, i: (bi, hi // g, j, 0))
@@ -382,24 +400,58 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
                                lambda bi, hi, j, i: (bi, hi, i, 0))
     dkv_out_spec = pl.BlockSpec((1, 1, block_kv, d),
                                 lambda bi, hi, j, i: (bi, hi, j, 0))
+    dqp_out_spec = pl.BlockSpec((1, 1, 1, block_q, d),
+                                lambda bi, hi, j, i: (bi, hi, j, i, 0))
 
     dkdv_in_specs = [q_spec_ks, kv_spec_ks, kv_spec_ks, q_spec_ks,
                      lse_spec_ks, q_spec_ks]
     if has_seg:
         dkdv_in_specs.extend(_seg_specs(block_q, block_kv, qs_order=False))
-    dk_h, dv_h = pl.pallas_call(
+    out_specs = [dkv_out_spec, dkv_out_spec]
+    out_shapes = [jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32),
+                  jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32)]
+    if stage_dq:
+        out_specs.append(dqp_out_spec)
+        out_shapes.append(
+            jax.ShapeDtypeStruct((b, h, nkv, sq, d), jnp.float32))
+    res = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, block_q=block_q,
-                          block_kv=block_kv, has_seg=has_seg),
+                          block_kv=block_kv, has_seg=has_seg,
+                          stage_dq=stage_dq),
         grid=(b, h, nkv, nq),
         in_specs=dkdv_in_specs,
-        out_specs=[dkv_out_spec, dkv_out_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32),
-                   jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32)],
+        out_specs=out_specs,
+        out_shape=out_shapes,
         scratch_shapes=[_vmem((block_kv, d), jnp.float32),
                         _vmem((block_kv, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, out, lse, do, *seg_inputs)
 
+    if stage_dq:
+        dk_h, dv_h, dq_p = res
+        dq = dq_p.sum(axis=2).astype(q.dtype)
+    else:
+        dk_h, dv_h = res
+        q_spec_qs = pl.BlockSpec((1, 1, block_q, d),
+                                 lambda bi, hi, i, j: (bi, hi, i, 0))
+        kv_spec_qs = pl.BlockSpec((1, 1, block_kv, d),
+                                  lambda bi, hi, i, j: (bi, hi // g, j, 0))
+        lse_spec_qs = pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                                   lambda bi, hi, i, j: (bi, hi, i, 0))
+        dq_in_specs = [q_spec_qs, kv_spec_qs, kv_spec_qs, q_spec_qs,
+                       lse_spec_qs, q_spec_qs]
+        if has_seg:
+            dq_in_specs.extend(_seg_specs(block_q, block_kv))
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
+                              block_kv=block_kv, has_seg=has_seg),
+            grid=(b, h, nq, nkv),
+            in_specs=dq_in_specs,
+            out_specs=q_spec_qs,
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            scratch_shapes=[_vmem((block_q, d), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, out, lse, do, *seg_inputs)
     dk = dk_h.reshape(b, kh, g, skv, d).sum(axis=2).astype(k.dtype)
     dv = dv_h.reshape(b, kh, g, skv, d).sum(axis=2).astype(v.dtype)
     return dq, dk, dv, None

@@ -1,0 +1,372 @@
+"""Pallas TPU paged attention: uniform query windows vs. a block-table cache.
+
+This is the serving attention kernel. One kernel covers every cached
+forward the server issues, because they are all the same computation at
+different window widths W:
+
+  * decode:                    W = 1
+  * speculative verification:  W = draft length + 1
+  * prefix-cache continuation: W = remainder bucket
+  * chunked prefill:           W = chunk
+
+The KV cache is PAGED: a global pool of fixed-size pages plus a per-slot
+int32 page table, so slot memory scales with actual context (not
+max_slots x max_len) and pages can be shared between slots (refcounted
+prefix reuse — see inference/block_allocator.py).
+
+Design (and why it can beat streaming the cache through XLA einsums):
+
+  * The pool lives in HBM (`memory_space=ANY`); the kernel issues its own
+    double-buffered async page copies. Each slot's loop runs
+    `cdiv(kv_len, page_size * pages_per_block)` iterations, so pages past
+    a slot's length are never fetched — XLA's dense path always streams
+    the full padded cache. While one block computes, the next block's
+    pages (possibly the next slot's) are already in flight.
+  * Page layout is (num_pages, KH, page_size, Dh): one page holds every
+    kv head for `page_size` positions, so a page is ONE contiguous DMA,
+    and the per-head (page_size, Dh) compute slices are contiguous views
+    — no strided sublane loads, no in-VMEM relayouts.
+  * Online softmax in f32 with per-(head, slot) running m/l/acc carried
+    through the loop as values (never re-read from scratch memory).
+  * int8 cache: pages are stored int8 with per-(position, head) absmax
+    scales in a sibling (num_pages, KH, page_size) f32 pool. Scales are
+    algebraically folded into score/prob ROWS (`q.(k*s) == (q.k_int8)*s`
+    since s is constant along Dh), so the kernel streams half the HBM
+    bytes and never materialises a dequantized page.
+
+The q/o layout is (B, KH, W*G, Dh) — grouped-query rows pre-folded per kv
+head — produced by the host-side wrapper below, so in-kernel q slices
+are contiguous too.
+
+Numerics match `ops.attention.causal_attention` (f32 scores and
+accumulators); parity is tested against `paged_attention_xla` in
+interpret mode on CPU and compiled on TPU
+(tests/test_paged_attention.py).
+
+Forward-only by design — serving never backprops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_attention_kernel(
+    # scalar prefetch
+    lens_ref,          # (B,) i32 — kv length per slot INCLUDING the window
+    tables_ref,        # (B, max_pages) i32
+    layer_ref,         # (1,) i32 — which pool layer this call attends to
+    # inputs
+    q_ref,             # (B, KH, WG, Dh) VMEM
+    k_pool_ref,        # (L, P, KH, ps, Dh) HBM (ANY)
+    v_pool_ref,        # (L, P, KH, ps, Dh) HBM (ANY)
+    *refs,             # [k_scale_pool, v_scale_pool,] o_ref, scratch...
+    scale: float,
+    batch: int,
+    w: int,
+    g: int,
+    kh: int,
+    ps: int,
+    npages: int,
+    int8_kv: bool,
+):
+    if int8_kv:
+        (ks_pool_ref, vs_pool_ref, o_ref,
+         kbuf, vbuf, ksbuf, vsbuf, sems) = refs
+    else:
+        o_ref, kbuf, vbuf, sems = refs
+        ks_pool_ref = vs_pool_ref = ksbuf = vsbuf = None
+    wg = w * g
+    d = q_ref.shape[-1]
+    num_pages_total = k_pool_ref.shape[1]
+    layer = layer_ref[0]
+    blk = ps * npages
+    # MXU prefers bf16 operands with f32 accumulation; int8 values are
+    # exact in bf16. f32 pools (CPU interpret tests) keep f32.
+    dot_dtype = (jnp.float32 if k_pool_ref.dtype == jnp.float32
+                 else jnp.bfloat16)
+
+    def n_blocks(b):
+        # every slot runs >= 1 block so the cross-slot DMA prefetch chain
+        # stays uniform (each started copy has exactly one matching wait)
+        return jnp.maximum(1, lax.div(lens_ref[b] + blk - 1, blk))
+
+    def _copies(buf_idx, page_ids):
+        """The async-copy descriptors of one block fetch; `start` on each
+        begins it, `wait` blocks until its bytes landed. The pool keeps
+        its layer dim so the SAME pool arrays serve every layer's call —
+        slicing the layer outside pallas would materialise a full-layer
+        copy per call."""
+        out = []
+        for i in range(npages):
+            page = page_ids[i]
+            sem = sems.at[buf_idx, i]
+            out.append(pltpu.make_async_copy(
+                k_pool_ref.at[layer, page], kbuf.at[buf_idx, i], sem))
+            out.append(pltpu.make_async_copy(
+                v_pool_ref.at[layer, page], vbuf.at[buf_idx, i], sem))
+            if int8_kv:
+                out.append(pltpu.make_async_copy(
+                    ks_pool_ref.at[layer, page], ksbuf.at[buf_idx, i], sem))
+                out.append(pltpu.make_async_copy(
+                    vs_pool_ref.at[layer, page], vsbuf.at[buf_idx, i], sem))
+        return out
+
+    def _block_pages(b, blk_idx):
+        """Page ids of block `blk_idx` of slot `b`, clamped into range so
+        out-of-bounds blocks fetch (masked) garbage instead of faulting."""
+        return [
+            jnp.clip(
+                tables_ref[b, jnp.clip(blk_idx * npages + i, 0,
+                                       tables_ref.shape[1] - 1)],
+                0, num_pages_total - 1)
+            for i in range(npages)
+        ]
+
+    def start_fetch(b, blk_idx, buf_idx):
+        for c in _copies(buf_idx, _block_pages(b, blk_idx)):
+            c.start()
+
+    def wait_fetch(buf_idx):
+        # waits pair up 1:1 with the starts issued into this buffer (the
+        # source index is irrelevant to wait; sizes match the starts)
+        for c in _copies(buf_idx, [0] * npages):
+            c.wait()
+
+    # prologue: first block of slot 0 into buffer 0
+    start_fetch(0, 0, 0)
+
+    buf_idx = jnp.int32(0)
+    for b in range(batch):  # static unroll over slots
+        kv_len = lens_ref[b]
+        # window row wi sits at absolute position kv_len - W + wi; rows of
+        # the folded (W*G, ...) layout map to window position row // G
+        row_pos = (kv_len - w) + lax.broadcasted_iota(
+            jnp.int32, (wg, blk), 0) // g
+
+        def body(i, carry, b=b, kv_len=kv_len, row_pos=row_pos):
+            buf_idx = carry[0]
+            state = carry[1:]
+            nb = n_blocks(b)
+
+            # prefetch next block (or the next slot's first block) into
+            # the other buffer while this one computes
+            is_last = i == nb - 1
+            nxt = jnp.where(is_last, 0, i + 1)
+            if b + 1 < batch:
+                nxt_b = jnp.where(is_last, b + 1, b)
+                start_fetch(nxt_b, nxt, 1 - buf_idx)
+            else:
+                @pl.when(jnp.logical_not(is_last))
+                def _():
+                    start_fetch(b, nxt, 1 - buf_idx)
+
+            wait_fetch(buf_idx)
+
+            col_pos = i * blk + lax.broadcasted_iota(
+                jnp.int32, (wg, blk), 1)
+            # col < kv_len is implied by col <= row for the last row but
+            # not for earlier window rows; both bounds are needed
+            mask = jnp.logical_and(col_pos <= row_pos, col_pos < kv_len)
+
+            new_state = []
+            for h in range(kh):
+                m_prev = state[3 * h]
+                l_prev = state[3 * h + 1]
+                acc_prev = state[3 * h + 2]
+                qh = q_ref[b, h].astype(dot_dtype)  # (WG, Dh)
+                cols = []
+                for p in range(npages):
+                    kp = kbuf[buf_idx, p, h].astype(dot_dtype)  # (ps, Dh)
+                    s_p = lax.dot_general(
+                        qh, kp, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)  # (WG, ps)
+                    if int8_kv:
+                        s_p = s_p * ksbuf[buf_idx, p, h].reshape(1, ps)
+                    cols.append(s_p)
+                qk = jnp.concatenate(cols, axis=1) * scale  # (WG, blk)
+                qk = jnp.where(mask, qk, NEG_INF)
+
+                m_cur = jnp.max(qk, axis=1, keepdims=True)   # (WG, 1)
+                m_new = jnp.maximum(m_prev, m_cur)
+                p_full = jnp.exp(qk - m_new)                 # (WG, blk)
+                corr = jnp.exp(m_prev - m_new)
+                l_new = (l_prev * corr
+                         + jnp.sum(p_full, axis=1, keepdims=True))
+                pv = jnp.zeros((wg, d), jnp.float32)
+                for p in range(npages):
+                    p_blk = p_full[:, p * ps:(p + 1) * ps]
+                    if int8_kv:
+                        p_blk = p_blk * vsbuf[buf_idx, p, h].reshape(1, ps)
+                    vp = vbuf[buf_idx, p, h].astype(dot_dtype)
+                    pv = pv + lax.dot_general(
+                        p_blk.astype(dot_dtype), vp,
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)  # (WG, Dh)
+                new_state += [m_new, l_new, acc_prev * corr + pv]
+            return tuple([1 - buf_idx] + new_state)
+
+        init = [buf_idx]
+        for _ in range(kh):
+            init += [jnp.full((wg, 1), NEG_INF, jnp.float32),
+                     jnp.zeros((wg, 1), jnp.float32),
+                     jnp.zeros((wg, d), jnp.float32)]
+        out = lax.fori_loop(0, n_blocks(b), body, tuple(init))
+        buf_idx = out[0]
+        for h in range(kh):
+            # inactive slots (kv_len 0) divide garbage by blk — finite,
+            # masked by the caller
+            l_h = jnp.maximum(out[1 + 3 * h + 1], 1e-30)
+            o_ref[b, h] = (out[1 + 3 * h + 2] / l_h).astype(o_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Host wrapper
+# ---------------------------------------------------------------------------
+
+
+def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
+                    scale=None, pages_per_block: int = 4,
+                    interpret: bool | None = None,
+                    k_scale_pool=None, v_scale_pool=None):
+    """Uniform-window attention against a paged KV cache.
+
+    Args:
+      q: (B, W, H, Dh) — W new positions per slot; slot b's window
+        occupies absolute positions [lengths[b] - W, lengths[b]). Its kv
+        entries must already be written to the pool (write-then-attend,
+        same contract as engine.verify_step).
+      k_pool, v_pool: (L, num_pages, KH, page_size, Dh) page pools
+        (cfg.dtype, or int8 with the scale pools given). The layer dim
+        stays on the operand — `layer` selects inside the kernel, so no
+        per-layer pool slice is ever materialised.
+      lengths: (B,) int32 — valid kv entries per slot INCLUDING the
+        window. Slots with length 0 are inactive (their output rows are
+        garbage; mask downstream).
+      tables: (B, max_pages_per_slot) int32 page table. Entries past a
+        slot's length may be arbitrary (they are clamped and masked).
+      layer: int or scalar int32 — pool layer to attend against.
+      k_scale_pool, v_scale_pool: (L, num_pages, KH, page_size) f32
+        absmax scales when the pools are int8.
+
+    Returns (B, W, H, Dh) in q.dtype. Equivalent to gathering each slot's
+    pages into a contiguous cache and running
+    `causal_attention(q, k, v, q_positions=lengths[:,None]-W+arange(W),
+    kv_length=lengths)` — see `paged_attention_xla` and the parity tests.
+    """
+    b, w, h, d = q.shape
+    _, num_pages, kh, ps, _ = k_pool.shape
+    g = h // kh
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    int8_kv = k_scale_pool is not None
+    npages = max(1, min(pages_per_block, tables.shape[1]))
+
+    # fold (W, G) query rows per kv head: (B, W, KH, G, Dh) -> (B, KH, WG, Dh)
+    qg = q.reshape(b, w, kh, g, d).transpose(0, 2, 1, 3, 4).reshape(
+        b, kh, w * g, d)
+
+    def _full(shape):
+        return pl.BlockSpec(shape, lambda i, *_: (0,) * len(shape))
+
+    in_specs = [
+        _full(qg.shape),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    inputs = [qg, k_pool, v_pool]
+    if int8_kv:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        inputs += [k_scale_pool, v_scale_pool]
+
+    scratch = [
+        pltpu.VMEM((2, npages, kh, ps, d), k_pool.dtype),   # k pages
+        pltpu.VMEM((2, npages, kh, ps, d), v_pool.dtype),   # v pages
+    ]
+    if int8_kv:
+        scratch += [pltpu.VMEM((2, npages, kh, ps), jnp.float32),
+                    pltpu.VMEM((2, npages, kh, ps), jnp.float32)]
+    scratch += [pltpu.SemaphoreType.DMA((2, npages))]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(1,),
+        in_specs=in_specs,
+        out_specs=_full((b, kh, w * g, d)),
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(
+        _paged_attention_kernel, scale=float(scale), batch=b, w=w, g=g,
+        kh=kh, ps=ps, npages=npages, int8_kv=int8_kv)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kh, w * g, d), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), tables.astype(jnp.int32),
+      jnp.asarray(layer, jnp.int32).reshape(1), *inputs)
+    # (B, KH, WG, Dh) -> (B, W, H, Dh)
+    return out.reshape(b, kh, w, g, d).transpose(0, 2, 1, 3, 4).reshape(
+        b, w, h, d)
+
+
+# ---------------------------------------------------------------------------
+# XLA reference (CPU tests / fallback)
+# ---------------------------------------------------------------------------
+
+
+def gather_pages(pool, tables, layer=0):
+    """(L, num_pages, KH, ps, Dh), (B, MP) -> contiguous
+    (B, MP*ps, KH, Dh) for `layer`."""
+    b, mp = tables.shape
+    _, _, kh, ps, d = pool.shape
+    lay = pool[layer]  # (P, KH, ps, D)
+    pages = lay[jnp.clip(tables, 0, lay.shape[0] - 1)]  # (B, MP, KH, ps, D)
+    return pages.transpose(0, 1, 3, 2, 4).reshape(b, mp * ps, kh, d)
+
+
+def gather_scale_pages(scale_pool, tables, layer=0):
+    """(L, num_pages, KH, ps), (B, MP) -> (B, MP*ps, KH, 1) f32."""
+    b, mp = tables.shape
+    _, _, kh, ps = scale_pool.shape
+    lay = scale_pool[layer]
+    pages = lay[jnp.clip(tables, 0, lay.shape[0] - 1)]
+    return pages.transpose(0, 1, 3, 2).reshape(b, mp * ps, kh, 1)
+
+
+def paged_attention_xla(q, k_pool, v_pool, lengths, tables, layer=0, *,
+                        scale=None, k_scale_pool=None, v_scale_pool=None):
+    """Dense-XLA equivalent of `paged_attention` (gather + masked attention).
+
+    The test oracle, and the serving fallback on non-TPU backends. The
+    gather materialises each slot's full padded cache view per call, so on
+    TPU the pallas kernel is strictly preferred.
+    """
+    from cloud_server_tpu.ops.attention import causal_attention
+
+    b, w, _, _ = q.shape
+    k = gather_pages(k_pool, tables, layer)
+    v = gather_pages(v_pool, tables, layer)
+    scales = {}
+    if k_scale_pool is not None:
+        scales = dict(k_scale=gather_scale_pages(k_scale_pool, tables, layer),
+                      v_scale=gather_scale_pages(v_scale_pool, tables, layer))
+    pos = lengths[:, None] - w + jnp.arange(w)[None, :]
+    return causal_attention(q, k, v, scale=scale, q_positions=pos,
+                            kv_length=lengths, **scales)

@@ -3,6 +3,17 @@
 # PALLAS_AXON_POOL_IPS stops sitecustomize from dialing the TPU relay
 # (one relay session per python process wedges concurrent runs and is
 # pointless for CPU tests).
+#
+# Default: the FAST set (~5-6 min) — everything except the tests marked
+# slow via tests/slow_tests.txt, which still covers every parallelism
+# family (dp/fsdp/tp, sp-ring, ulysses, pp, ep, hybrid-dcn) plus the
+# engine/server/checkpoint flows.
+#   ./run_tests.sh --all   # full sweep (~30 min)
+#   ./run_tests.sh <pytest args...>  # fast set with extra args
+MARK=(-m "not slow")
+if [ "$1" = "--all" ]; then
+    MARK=(); shift
+fi
 if [ "$#" -eq 0 ]; then set -- -x -q; fi
 exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python -m pytest tests/ "$@"
+    python -m pytest tests/ "${MARK[@]}" "$@"

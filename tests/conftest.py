@@ -35,6 +35,27 @@ jax.config.update("jax_threefry_partitionable", True)
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_collection_modifyitems(config, items):
+    """Mark the measured-slow tests (tests/slow_tests.txt, regenerated
+    from `pytest --durations`) so the default run is a <6-minute fast set
+    that still covers every parallelism family; `run_tests.sh --all`
+    runs everything. Unlisted (new) tests default to fast until
+    re-measured."""
+    slow_file = os.path.join(os.path.dirname(__file__), "slow_tests.txt")
+    try:
+        with open(slow_file) as f:
+            entries = [line.strip() for line in f if line.strip()]
+    except OSError:
+        return
+    slow_ids = {e for e in entries if not e.endswith("*")}
+    slow_prefixes = tuple(e[:-1] for e in entries if e.endswith("*"))
+    for item in items:
+        nodeid = item.nodeid.replace(os.sep, "/")
+        if nodeid in slow_ids or (slow_prefixes
+                                  and nodeid.startswith(slow_prefixes)):
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

@@ -1,0 +1,131 @@
+"""Host-side page allocator: refcounts, prefix cache, LRU eviction."""
+
+import pytest
+
+from cloud_server_tpu.inference.block_allocator import BlockAllocator
+
+
+def toks(n, base=0):
+    return [base + i for i in range(n)]
+
+
+def test_alloc_release_roundtrip():
+    a = BlockAllocator(4, page_size=4)
+    pages = a.alloc(3)
+    assert len(pages) == 3 and len(set(pages)) == 3
+    assert a.available == 1
+    # partial coverage: only one full page cacheable (8 tokens = 2 pages)
+    a.release(pages, toks(9))
+    st = a.stats()
+    assert st.pages_free + st.pages_cached == 4
+    assert st.pages_cached == 2  # two full pages keyed, tail freed
+
+
+def test_alloc_insufficient_is_side_effect_free():
+    a = BlockAllocator(2, page_size=4)
+    assert a.alloc(3) is None
+    assert a.available == 2
+    assert a.alloc(2) is not None
+
+
+def test_prefix_reuse_hits_after_release():
+    a = BlockAllocator(8, page_size=4)
+    prompt = toks(11)  # 2 full pages + 3 tail tokens
+    shared, n = a.lookup_prefix(prompt)
+    assert shared == [] and n == 0
+    pages = a.alloc(3)
+    a.release(pages, prompt)
+    shared, n = a.lookup_prefix(prompt)
+    assert len(shared) == 2 and n == 8
+    assert shared == pages[:2]
+    assert a.prefix_hit_pages == 2
+    # the shared pages are active again (refcount 1) — not evictable
+    assert a.stats().pages_active == 2
+    a.release(shared, prompt[:8])
+
+
+def test_full_page_boundary_leaves_one_token():
+    """A prompt that is exactly N full pages shares at most N-1 pages —
+    admission must keep >= 1 token to produce first-token logits."""
+    a = BlockAllocator(8, page_size=4)
+    prompt = toks(8)
+    pages = a.alloc(2)
+    a.release(pages, prompt)
+    shared, n = a.lookup_prefix(prompt)
+    assert len(shared) == 1 and n == 4
+    a.release(shared, prompt[:4])
+
+
+def test_concurrent_sharing_refcounts():
+    a = BlockAllocator(8, page_size=2)
+    prompt = toks(5)
+    pages = a.alloc(3)
+    a.release(pages, prompt)
+    s1, _ = a.lookup_prefix(prompt)
+    s2, _ = a.lookup_prefix(prompt)
+    assert s1 == s2 and len(s1) == 2
+    assert a.stats().pages_active == 2
+    a.release(s1, prompt[:4])
+    assert a.stats().pages_active == 2  # s2 still holds them
+    a.release(s2, prompt[:4])
+    assert a.stats().pages_active == 0
+    assert a.stats().pages_cached == 2
+
+
+def test_eviction_lru_under_pressure():
+    a = BlockAllocator(4, page_size=2)
+    p1 = a.alloc(2)
+    a.release(p1, toks(4, base=0))      # caches 2 pages (older)
+    p2 = a.alloc(2)
+    a.release(p2, toks(4, base=100))    # caches 2 pages (newer)
+    assert a.stats().pages_cached == 4
+    got = a.alloc(2)                     # must evict the LRU (p1) chain
+    assert got is not None
+    assert a.evictions == 2
+    shared, _ = a.lookup_prefix(toks(5, base=100))
+    assert len(shared) == 2  # newer chain survived
+    a.release(shared, toks(4, base=100))
+    a.release(got, [])
+
+
+def test_chain_key_requires_matching_parent():
+    """Same page tokens under a different prefix must NOT hit."""
+    a = BlockAllocator(8, page_size=2)
+    p = a.alloc(2)
+    a.release(p, [1, 2, 3, 4])
+    shared, n = a.lookup_prefix([9, 9, 3, 4, 5])
+    assert shared == [] and n == 0
+    shared, n = a.lookup_prefix([1, 2, 3, 4, 5])
+    assert len(shared) == 2
+    a.release(shared, [1, 2, 3, 4])
+
+
+def test_duplicate_content_frees_extra_page():
+    a = BlockAllocator(8, page_size=2)
+    p1 = a.alloc(1)
+    a.release(p1, [7, 8])
+    p2 = a.alloc(1)
+    a.release(p2, [7, 8])  # same key: second page freed, not cached
+    st = a.stats()
+    assert st.pages_cached == 1
+    assert st.pages_free == 7
+    shared, _ = a.lookup_prefix([7, 8, 1])
+    assert shared == p1
+    a.release(shared, [7, 8])
+
+
+def test_release_with_no_committed_tokens_frees_everything():
+    a = BlockAllocator(4, page_size=4)
+    pages = a.alloc(4)
+    a.release(pages, [])
+    st = a.stats()
+    assert st.pages_free == 4 and st.pages_cached == 0
+
+
+@pytest.mark.parametrize("n", [1, 3])
+def test_available_counts_evictable(n):
+    a = BlockAllocator(4, page_size=2)
+    p = a.alloc(n)
+    a.release(p, toks(2 * n))
+    assert a.available == 4
+    assert a.stats().pages_cached == n
