@@ -1,19 +1,22 @@
 """Steady-state decode-attention microbench on the real TPU.
 
 Compares, at the serving-bench shape (B=8 slots, S=1024 context, MHA
-KH=16, Dh=64, L-free single-layer pools):
+KH=16, Dh=64, single-layer pools):
 
   * xla-dense      — `causal_attention` over the contiguous cache (the
-                     engine's default decode path)
-  * xla-int8       — same, int8 cache with scales folded into the einsums
-  * paged-pallas   — `ops.paged_attention` kernel (W in {1, 4})
-  * paged-int8     — the kernel on int8 pools + scale pools
-  * paged-xla      — the gather-based reference (expected slow; sanity)
+                     engine's default decode path), bf16 and int8 caches
+  * paged-pallas   — `ops.paged_attention` kernel (W in {1, 4}), bf16 and
+                     int8 pools
 
-Method: one jit per case runs a `lax.scan` of ITERS attention calls with
-the output fed back into the query (so nothing hoists), amortising the
-axon tunnel's per-dispatch ~3 ms. Reported per-iteration time divides by
-ITERS; effective bandwidth counts one cache read per iteration.
+Methodology — the axon tunnel's fixed cost is ~100 ms per
+dispatch+device_get ROUND TRIP (measured 2026-07-30; `block_until_ready`
+does NOT truly synchronize through the tunnel — only a device_get does),
+so a single timed call measures the tunnel, not the kernel. Each case
+therefore runs TWO jits that scan the attention N1 and N2 times with the
+output fed back into the query (nothing hoists), and reports
+(t(N2) - t(N1)) / (N2 - N1): the fixed cost cancels, leaving the
+per-iteration device time. Effective bandwidth counts one cache read per
+iteration.
 
 Run:  python benchmarks/decode_attention_bench.py
 (KEEP the axon env vars; run nothing else concurrently.)
@@ -21,7 +24,7 @@ Run:  python benchmarks/decode_attention_bench.py
 
 from __future__ import annotations
 
-import functools
+import os
 import time
 
 import jax
@@ -30,34 +33,36 @@ import numpy as np
 from jax import lax
 
 from cloud_server_tpu.inference.engine import _kv_quant
+from cloud_server_tpu.inference.paged_engine import quantize_pool
 from cloud_server_tpu.ops.attention import causal_attention
 from cloud_server_tpu.ops.paged_attention import paged_attention
 
 B, S, H, KH, D = 8, 1024, 16, 16, 64
-PS = 64
-ITERS = 50
+PS = 128
+N1, N2 = 100, 400
 
 
-def _timeit(fn, *args):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    out = fn(*args)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / ITERS
-    return dt
+def _sync(x):
+    jax.device_get(jax.tree.leaves(x)[0].ravel()[0])
 
 
-def _scan(body, q0):
-    def f(q, _):
-        return body(q), None
-
-    return lax.scan(f, q0, None, length=ITERS)[0]
+def _diff_time(make_fn, q0):
+    """Per-iteration seconds via the two-length differential."""
+    t = {}
+    for n in (N1, N2):
+        fn = jax.jit(make_fn(n))
+        _sync(fn(q0))  # compile + warm
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            _sync(fn(q0))
+            best = min(best, time.perf_counter() - t0)
+        t[n] = best
+    return (t[N2] - t[N1]) / (N2 - N1)
 
 
 def main():
-    key = jax.random.key(0)
-    ks = jax.random.split(key, 8)
+    ks = jax.random.split(jax.random.key(0), 8)
     dtype = jnp.bfloat16
     lens = jnp.full((B,), S, jnp.int32)
 
@@ -67,84 +72,85 @@ def main():
     kq_cat, ksc_cat = _kv_quant(k_cat)
     vq_cat, vsc_cat = _kv_quant(v_cat)
 
-    # paged pools (1 "layer")
+    # paged pools (1 "layer"), transposed pages (L, P, KH, Dh, ps)
     mp = S // PS
     num_pages = B * mp
     perm = np.random.RandomState(0).permutation(num_pages)
     tables = jnp.asarray(perm.reshape(B, mp), jnp.int32)
-    k_pool = jax.random.normal(ks[2], (1, num_pages, KH, PS, D), dtype)
-    v_pool = jax.random.normal(ks[3], (1, num_pages, KH, PS, D), dtype)
-    kq_pool, ksc_pool = _kv_quant(k_pool)
-    vq_pool, vsc_pool = _kv_quant(v_pool)
-    ksc_pool, vsc_pool = ksc_pool[..., 0], vsc_pool[..., 0]
+    k_pool = jax.random.normal(ks[2], (1, num_pages, KH, D, PS), dtype)
+    v_pool = jax.random.normal(ks[3], (1, num_pages, KH, D, PS), dtype)
+
+    kq_pool, ksc_pool = quantize_pool(k_pool)
+    vq_pool, vsc_pool = quantize_pool(v_pool)
 
     cache_bytes = {"bf16": 2 * B * S * KH * D * 2,
                    "int8": 2 * B * S * KH * D + 2 * B * S * KH * 4}
-
     results = {}
+    only = os.environ.get("BENCH_CASES", "")  # substring filter
 
-    def report(name, dt, kind):
+    def report(name, timer, kind):
+        if only and only not in name:
+            return
+        dt = timer()
         gbs = cache_bytes[kind] / dt / 1e9
         results[name] = dt
-        print(f"{name:28s} {dt * 1e6:9.1f} us/iter   {gbs:7.1f} GB/s eff")
+        print(f"{name:30s} {dt * 1e6:9.1f} us/iter   {gbs:7.1f} GB/s eff",
+              flush=True)
 
-    # ---- XLA dense over contiguous cache --------------------------------
-    @jax.jit
-    def xla_dense(q0):
-        def body(q):
-            o = causal_attention(q, k_cat, v_cat,
-                                 q_positions=(lens - 1)[:, None],
-                                 kv_length=lens)
-            return o.astype(q.dtype)
-        return _scan(body, q0)
+    def scan_of(body, n):
+        def fn(q0):
+            def f(q, _):
+                return body(q).astype(q.dtype), None
+            return lax.scan(f, q0, None, length=n)[0]
+        return fn
 
-    q0 = jax.random.normal(ks[4], (B, 1, H, D), dtype)
-    report("xla-dense bf16 W=1", _timeit(xla_dense, q0), "bf16")
+    q1 = jax.random.normal(ks[4], (B, 1, H, D), dtype)
 
-    @jax.jit
-    def xla_int8(q0):
-        def body(q):
-            o = causal_attention(q, kq_cat, vq_cat,
-                                 q_positions=(lens - 1)[:, None],
-                                 kv_length=lens,
-                                 k_scale=ksc_cat, v_scale=vsc_cat)
-            return o.astype(q.dtype)
-        return _scan(body, q0)
+    def xla_body(q):
+        return causal_attention(q, k_cat, v_cat,
+                                q_positions=(lens - 1)[:, None],
+                                kv_length=lens)
 
-    report("xla-dense int8 W=1", _timeit(xla_int8, q0), "int8")
+    report("xla-dense bf16 W=1",
+           lambda: _diff_time(lambda n: scan_of(xla_body, n), q1), "bf16")
 
-    # ---- paged kernel ----------------------------------------------------
+    def xla8_body(q):
+        return causal_attention(q, kq_cat, vq_cat,
+                                q_positions=(lens - 1)[:, None],
+                                kv_length=lens,
+                                k_scale=ksc_cat, v_scale=vsc_cat)
+
+    report("xla-dense int8 W=1",
+           lambda: _diff_time(lambda n: scan_of(xla8_body, n), q1), "int8")
+
     for w in (1, 4):
         qw = jax.random.normal(ks[5], (B, w, H, D), dtype)
         for npb in (2, 4, 8):
-            @jax.jit
-            def paged(q0, npb=npb, w=w):
-                def body(q):
-                    o = paged_attention(q, k_pool, v_pool, lens, tables, 0,
-                                        pages_per_block=npb,
-                                        interpret=False)
-                    return o.astype(q.dtype)
-                return _scan(body, q0)
+            def paged_body(q, npb=npb):
+                return paged_attention(q, k_pool, v_pool, lens, tables, 0,
+                                       pages_per_block=npb,
+                                       interpret=False)
 
             report(f"paged-pallas bf16 W={w} npb={npb}",
-                   _timeit(paged, qw), "bf16")
+                   lambda: _diff_time(
+                       lambda n: scan_of(paged_body, n), qw),
+                   "bf16")
 
-        @jax.jit
-        def paged8(q0, w=w):
-            def body(q):
-                o = paged_attention(q, kq_pool, vq_pool, lens, tables, 0,
-                                    pages_per_block=4, interpret=False,
-                                    k_scale_pool=ksc_pool,
-                                    v_scale_pool=vsc_pool)
-                return o.astype(q.dtype)
-            return _scan(body, q0)
+        for npb in (4, 8):
+            def paged8_body(q, npb=npb):
+                return paged_attention(q, kq_pool, vq_pool, lens, tables, 0,
+                                       pages_per_block=npb, interpret=False,
+                                       k_scale_pool=ksc_pool,
+                                       v_scale_pool=vsc_pool)
 
-        report(f"paged-pallas int8 W={w} npb=4", _timeit(paged8, qw),
-               "int8")
+            report(f"paged-pallas int8 W={w} npb={npb}",
+                   lambda: _diff_time(
+                       lambda n: scan_of(paged8_body, n), qw), "int8")
 
     base = results.get("xla-dense bf16 W=1")
-    for name, dt in results.items():
-        print(f"{name:28s} speedup vs xla-dense: {base / dt:5.2f}x")
+    if base:
+        for name, dt in results.items():
+            print(f"{name:30s} speedup vs xla-dense: {base / dt:5.2f}x")
 
 
 if __name__ == "__main__":

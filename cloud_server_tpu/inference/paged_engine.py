@@ -52,8 +52,9 @@ from cloud_server_tpu.ops.paged_attention import (
 class PagedKVCache(NamedTuple):
     """Page pool + per-slot view. One pool serves every slot and layer."""
 
-    k: jnp.ndarray        # (L, num_pages, KH, ps, Dh) cfg.dtype | int8
-    v: jnp.ndarray        # (L, num_pages, KH, ps, Dh)
+    k: jnp.ndarray        # (L, num_pages, KH, Dh, ps) cfg.dtype | int8
+    v: jnp.ndarray        # (L, num_pages, KH, Dh, ps) — transposed pages
+    #                       (positions on lanes; see ops/paged_attention)
     lengths: jnp.ndarray  # (B,) int32 — committed kv entries per slot
     tables: jnp.ndarray   # (B, max_pages_per_slot) int32; num_pages = free
     k_scale: jnp.ndarray | None = None  # (L, num_pages, KH, ps) f32
@@ -61,7 +62,7 @@ class PagedKVCache(NamedTuple):
 
     @property
     def page_size(self) -> int:
-        return self.k.shape[3]
+        return self.k.shape[4]
 
     @property
     def num_pages(self) -> int:
@@ -75,12 +76,12 @@ class PagedKVCache(NamedTuple):
 def init_paged_cache(cfg: ModelConfig, *, num_pages: int, page_size: int,
                      batch: int, max_pages_per_slot: int) -> PagedKVCache:
     """Zeroed pool; all tables at the sentinel (num_pages = "no page")."""
-    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size,
-             cfg.head_dim)
+    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, cfg.head_dim,
+             page_size)
     tables = jnp.full((batch, max_pages_per_slot), num_pages, jnp.int32)
     lengths = jnp.zeros((batch,), jnp.int32)
     if cfg.kv_cache_dtype == "int8":
-        sshape = shape[:-1]
+        sshape = shape[:3] + (page_size,)
         return PagedKVCache(
             k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
             lengths=lengths, tables=tables,
@@ -91,6 +92,20 @@ def init_paged_cache(cfg: ModelConfig, *, num_pages: int, page_size: int,
     dtype = jnp.dtype(cfg.dtype)
     return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
                         lengths=lengths, tables=tables)
+
+
+def quantize_pool(pool: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """int8-quantize a TRANSPOSED page pool (L, P, KH, Dh, ps): absmax
+    over Dh (axis 3) — the same per-(position, head) granularity
+    `_write_window` stores via `engine._kv_quant`. Single source of truth
+    for tests and benches building pools wholesale.
+
+    Returns (int8 pool, (L, P, KH, ps) f32 scales)."""
+    sc = jnp.maximum(
+        jnp.max(jnp.abs(pool.astype(jnp.float32)), axis=3,
+                keepdims=True) / 127.0, 1e-8)
+    q = jnp.round(pool.astype(jnp.float32) / sc).astype(jnp.int8)
+    return q, sc[:, :, :, 0, :]
 
 
 def hbm_bytes(cache: PagedKVCache) -> int:
@@ -104,8 +119,8 @@ def hbm_bytes(cache: PagedKVCache) -> int:
 
 def _write_window(cache: PagedKVCache, layer: int, k, v, pos):
     """Scatter fresh (B, W, KH, Dh) k/v at absolute positions (B, W)
-    through the page table. Out-of-chain positions (sentinel table
-    entries) drop."""
+    through the page table (pages store positions on the minor dim).
+    Out-of-chain positions (sentinel table entries) drop."""
     ps = cache.page_size
     page_slot = jnp.clip(pos // ps, 0, cache.tables.shape[1] - 1)
     pages = jnp.take_along_axis(cache.tables, page_slot, axis=1)  # (B, W)
@@ -114,25 +129,25 @@ def _write_window(cache: PagedKVCache, layer: int, k, v, pos):
         kq, ksc = _kv_quant(k)
         vq, vsc = _kv_quant(v)
         return cache._replace(
-            k=cache.k.at[layer, pages, :, offs, :].set(
+            k=cache.k.at[layer, pages, :, :, offs].set(
                 kq.astype(cache.k.dtype), mode="drop"),
-            v=cache.v.at[layer, pages, :, offs, :].set(
+            v=cache.v.at[layer, pages, :, :, offs].set(
                 vq.astype(cache.v.dtype), mode="drop"),
             k_scale=cache.k_scale.at[layer, pages, :, offs].set(
                 ksc[..., 0], mode="drop"),
             v_scale=cache.v_scale.at[layer, pages, :, offs].set(
                 vsc[..., 0], mode="drop"))
     return cache._replace(
-        k=cache.k.at[layer, pages, :, offs, :].set(
+        k=cache.k.at[layer, pages, :, :, offs].set(
             k.astype(cache.k.dtype), mode="drop"),
-        v=cache.v.at[layer, pages, :, offs, :].set(
+        v=cache.v.at[layer, pages, :, :, offs].set(
             v.astype(cache.v.dtype), mode="drop"))
 
 
 def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
                    cache: PagedKVCache, *, logits_at: jnp.ndarray | None,
                    all_logits: bool = False,
-                   pages_per_block: int = 4):
+                   pages_per_block: int = 8):
     """Forward W new positions per slot against the paged cache.
 
     Args:

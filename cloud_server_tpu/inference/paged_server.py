@@ -292,7 +292,7 @@ class PagedInferenceServer:
 
     def __init__(self, params, cfg: ModelConfig, infer_cfg: InferConfig, *,
                  max_slots: int = 8, max_context: int = 1024,
-                 page_size: int = 64, num_pages: int | None = None,
+                 page_size: int = 128, num_pages: int | None = None,
                  prompt_buckets: Sequence[int] | None = None,
                  decode_chunk: int = 8, spec_drafts: int = 0,
                  prefill_chunk: int = 256, seed: int = 0):
@@ -318,6 +318,13 @@ class PagedInferenceServer:
         if max_context % page_size:
             raise ValueError(f"{max_context=} must be a multiple of "
                              f"{page_size=}")
+        if (cfg.decode_attention_impl == "pallas"
+                and jax.default_backend() == "tpu" and page_size % 128):
+            # fail at construction, not at the first dispatch — the TPU
+            # kernel's manual-DMA slices tile the minor dim by 128
+            raise ValueError(
+                f"page_size={page_size} must be a multiple of 128 for the "
+                "pallas decode path on TPU")
         self.max_context = max_context
         self.max_pages_per_slot = max_context // page_size
         if num_pages is None:

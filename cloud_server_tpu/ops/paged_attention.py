@@ -22,10 +22,14 @@ Design (and why it can beat streaming the cache through XLA einsums):
     a slot's length are never fetched — XLA's dense path always streams
     the full padded cache. While one block computes, the next block's
     pages (possibly the next slot's) are already in flight.
-  * Page layout is (num_pages, KH, page_size, Dh): one page holds every
-    kv head for `page_size` positions, so a page is ONE contiguous DMA,
-    and the per-head (page_size, Dh) compute slices are contiguous views
-    — no strided sublane loads, no in-VMEM relayouts.
+  * Page layout is (num_pages, KH, Dh, page_size) — pages are stored
+    TRANSPOSED, positions on the minor (lane) dim. One page holds every
+    kv head for `page_size` positions, so a page is ONE contiguous DMA;
+    a per-head slice is a contiguous (Dh, ps) view — exactly the
+    transposed right-hand operand the qk matmul wants, with a lane dim
+    (ps = 128) that satisfies Mosaic's minor-dim tiling for manual DMA
+    slices regardless of head_dim (a position-minor layout would put Dh
+    on lanes, and Dh = 64 is not 128-tileable).
   * Online softmax in f32 with per-(head, slot) running m/l/acc carried
     through the loop as values (never re-read from scratch memory).
   * int8 cache: pages are stored int8 with per-(position, head) absmax
@@ -59,6 +63,18 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+def _dot(a, b, dims):
+    """dot_general with f32 accumulation and dtype-determined precision:
+    bf16 operands must use DEFAULT precision (a global
+    jax_default_matmul_precision="highest" would request an fp32
+    contraction on bf16 vectors, which Mosaic rejects — "Bad lhs type");
+    f32 operands keep HIGHEST so interpret-mode parity stays exact."""
+    prec = (lax.Precision.DEFAULT if a.dtype == jnp.bfloat16
+            else lax.Precision.HIGHEST)
+    return lax.dot_general(a, b, (dims, ((), ())), precision=prec,
+                           preferred_element_type=jnp.float32)
+
+
 # ---------------------------------------------------------------------------
 # Kernel
 # ---------------------------------------------------------------------------
@@ -71,8 +87,8 @@ def _paged_attention_kernel(
     layer_ref,         # (1,) i32 — which pool layer this call attends to
     # inputs
     q_ref,             # (B, KH, WG, Dh) VMEM
-    k_pool_ref,        # (L, P, KH, ps, Dh) HBM (ANY)
-    v_pool_ref,        # (L, P, KH, ps, Dh) HBM (ANY)
+    k_pool_ref,        # (L, P, KH, Dh, ps) HBM (ANY) — transposed pages
+    v_pool_ref,        # (L, P, KH, Dh, ps) HBM (ANY)
     *refs,             # [k_scale_pool, v_scale_pool,] o_ref, scratch...
     scale: float,
     batch: int,
@@ -190,10 +206,8 @@ def _paged_attention_kernel(
                 qh = q_ref[b, h].astype(dot_dtype)  # (WG, Dh)
                 cols = []
                 for p in range(npages):
-                    kp = kbuf[buf_idx, p, h].astype(dot_dtype)  # (ps, Dh)
-                    s_p = lax.dot_general(
-                        qh, kp, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32)  # (WG, ps)
+                    kp = kbuf[buf_idx, p, h].astype(dot_dtype)  # (Dh, ps)
+                    s_p = _dot(qh, kp, ((1,), (0,)))  # (WG, ps)
                     if int8_kv:
                         s_p = s_p * ksbuf[buf_idx, p, h].reshape(1, ps)
                     cols.append(s_p)
@@ -211,11 +225,9 @@ def _paged_attention_kernel(
                     p_blk = p_full[:, p * ps:(p + 1) * ps]
                     if int8_kv:
                         p_blk = p_blk * vsbuf[buf_idx, p, h].reshape(1, ps)
-                    vp = vbuf[buf_idx, p, h].astype(dot_dtype)
-                    pv = pv + lax.dot_general(
-                        p_blk.astype(dot_dtype), vp,
-                        (((1,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32)  # (WG, Dh)
+                    vp = vbuf[buf_idx, p, h].astype(dot_dtype)  # (Dh, ps)
+                    pv = pv + _dot(p_blk.astype(dot_dtype), vp,
+                                   ((1,), (1,)))  # (WG, Dh)
                 new_state += [m_new, l_new, acc_prev * corr + pv]
             return tuple([1 - buf_idx] + new_state)
 
@@ -249,10 +261,11 @@ def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
         occupies absolute positions [lengths[b] - W, lengths[b]). Its kv
         entries must already be written to the pool (write-then-attend,
         same contract as engine.verify_step).
-      k_pool, v_pool: (L, num_pages, KH, page_size, Dh) page pools
-        (cfg.dtype, or int8 with the scale pools given). The layer dim
-        stays on the operand — `layer` selects inside the kernel, so no
-        per-layer pool slice is ever materialised.
+      k_pool, v_pool: (L, num_pages, KH, Dh, page_size) TRANSPOSED page
+        pools (cfg.dtype, or int8 with the scale pools given). The layer
+        dim stays on the operand — `layer` selects inside the kernel, so
+        no per-layer pool slice is ever materialised. On TPU, page_size
+        must be a multiple of 128 (the manual-DMA lane tiling).
       lengths: (B,) int32 — valid kv entries per slot INCLUDING the
         window. Slots with length 0 are inactive (their output rows are
         garbage; mask downstream).
@@ -268,12 +281,16 @@ def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
     kv_length=lengths)` — see `paged_attention_xla` and the parity tests.
     """
     b, w, h, d = q.shape
-    _, num_pages, kh, ps, _ = k_pool.shape
+    _, num_pages, kh, _, ps = k_pool.shape
     g = h // kh
     if scale is None:
         scale = d ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if not interpret and ps % 128:
+        raise ValueError(
+            f"page_size={ps} must be a multiple of 128 on TPU (Mosaic "
+            "manual-DMA slices tile the minor dim by 128)")
     int8_kv = k_scale_pool is not None
     npages = max(1, min(pages_per_block, tables.shape[1]))
 
@@ -296,8 +313,8 @@ def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
         inputs += [k_scale_pool, v_scale_pool]
 
     scratch = [
-        pltpu.VMEM((2, npages, kh, ps, d), k_pool.dtype),   # k pages
-        pltpu.VMEM((2, npages, kh, ps, d), v_pool.dtype),   # v pages
+        pltpu.VMEM((2, npages, kh, d, ps), k_pool.dtype),   # k pages
+        pltpu.VMEM((2, npages, kh, d, ps), v_pool.dtype),   # v pages
     ]
     if int8_kv:
         scratch += [pltpu.VMEM((2, npages, kh, ps), jnp.float32),
@@ -332,13 +349,13 @@ def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
 
 
 def gather_pages(pool, tables, layer=0):
-    """(L, num_pages, KH, ps, Dh), (B, MP) -> contiguous
+    """(L, num_pages, KH, Dh, ps), (B, MP) -> contiguous
     (B, MP*ps, KH, Dh) for `layer`."""
     b, mp = tables.shape
-    _, _, kh, ps, d = pool.shape
-    lay = pool[layer]  # (P, KH, ps, D)
-    pages = lay[jnp.clip(tables, 0, lay.shape[0] - 1)]  # (B, MP, KH, ps, D)
-    return pages.transpose(0, 1, 3, 2, 4).reshape(b, mp * ps, kh, d)
+    _, _, kh, d, ps = pool.shape
+    lay = pool[layer]  # (P, KH, D, ps)
+    pages = lay[jnp.clip(tables, 0, lay.shape[0] - 1)]  # (B, MP, KH, D, ps)
+    return pages.transpose(0, 1, 4, 2, 3).reshape(b, mp * ps, kh, d)
 
 
 def gather_scale_pages(scale_pool, tables, layer=0):

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from cloud_server_tpu.inference.engine import _kv_quant
+from cloud_server_tpu.inference.paged_engine import quantize_pool
 from cloud_server_tpu.ops.attention import causal_attention
 from cloud_server_tpu.ops.paged_attention import (
     gather_pages, paged_attention, paged_attention_xla)
@@ -15,10 +15,11 @@ from cloud_server_tpu.ops.paged_attention import (
 
 def _make_case(rng, *, b=3, w=1, h=4, kh=2, d=16, ps=8, mp=6, L=2,
                num_pages=32, dtype=jnp.float32):
-    """Random pools + a random (valid) paging of each slot's history."""
+    """Random pools + a random (valid) paging of each slot's history.
+    Pools are TRANSPOSED pages: (L, P, KH, Dh, ps)."""
     ks = jax.random.split(rng, 6)
-    k_pool = jax.random.normal(ks[0], (L, num_pages, kh, ps, d), dtype)
-    v_pool = jax.random.normal(ks[1], (L, num_pages, kh, ps, d), dtype)
+    k_pool = jax.random.normal(ks[0], (L, num_pages, kh, d, ps), dtype)
+    v_pool = jax.random.normal(ks[1], (L, num_pages, kh, d, ps), dtype)
     q = jax.random.normal(ks[2], (b, w, h, d), dtype)
     # distinct random pages per slot => aliasing bugs show as mismatches
     perm = np.random.RandomState(0).permutation(num_pages)[:b * mp]
@@ -75,20 +76,16 @@ def test_kernel_interpret_short_lengths():
     assert bool(jnp.isfinite(got).all())
 
 
-def _quantize_pool(pool):
-    """(L, P, KH, ps, D) -> int8 pool + (L, P, KH, ps) scales."""
-    qv, sc = _kv_quant(pool)  # scales (..., ps, 1) over last axis
-    return qv, sc[..., 0]
 
 
 @pytest.mark.parametrize("impl", ["xla", "kernel"])
 def test_int8_scales_paths(impl):
     q, k_pool, v_pool, lengths, tables = _make_case(jax.random.key(3), w=2)
-    kq, ksc = _quantize_pool(k_pool)
-    vq, vsc = _quantize_pool(v_pool)
-    # oracle: dequantize then dense
-    k_deq = (kq.astype(jnp.float32) * ksc[..., None])
-    v_deq = (vq.astype(jnp.float32) * vsc[..., None])
+    kq, ksc = quantize_pool(k_pool)
+    vq, vsc = quantize_pool(v_pool)
+    # oracle: dequantize then dense (scales broadcast over the Dh axis)
+    k_deq = (kq.astype(jnp.float32) * ksc[:, :, :, None, :])
+    v_deq = (vq.astype(jnp.float32) * vsc[:, :, :, None, :])
     want = _dense_ref(q, k_deq, v_deq, lengths, tables, 1)
     if impl == "xla":
         got = paged_attention_xla(q, kq, vq, lengths, tables, 1,
@@ -107,7 +104,7 @@ def test_compiled_on_tpu_paged_attention():
     if os.environ.get("CST_TPU_TESTS") != "1":
         pytest.skip("TPU-gated (set CST_TPU_TESTS=1)")
     q, k_pool, v_pool, lengths, tables = _make_case(
-        jax.random.key(4), b=4, w=4, h=8, kh=8, d=64, ps=64, mp=4,
+        jax.random.key(4), b=4, w=4, h=8, kh=8, d=64, ps=128, mp=4,
         num_pages=32, dtype=jnp.bfloat16)
     fn = jax.jit(functools.partial(paged_attention, pages_per_block=2,
                                    interpret=False))
