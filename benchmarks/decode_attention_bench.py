@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from bench import diff_time_scan
 from cloud_server_tpu.inference.engine import _kv_quant
 from cloud_server_tpu.inference.paged_engine import quantize_pool
 from cloud_server_tpu.ops.attention import causal_attention
@@ -39,26 +40,13 @@ from cloud_server_tpu.ops.paged_attention import paged_attention
 
 B, S, H, KH, D = 8, 1024, 16, 16, 64
 PS = 128
-N1, N2 = 100, 400
-
-
-def _sync(x):
-    jax.device_get(jax.tree.leaves(x)[0].ravel()[0])
+# 1500-iteration delta at ~50-200 us/iter >> the tunnel's ~30 ms
+# fixed-cost variance (shorter deltas have produced negative estimates)
+N1, N2 = 100, 1600
 
 
 def _diff_time(make_fn, q0):
-    """Per-iteration seconds via the two-length differential."""
-    t = {}
-    for n in (N1, N2):
-        fn = jax.jit(make_fn(n))
-        _sync(fn(q0))  # compile + warm
-        best = float("inf")
-        for _ in range(2):
-            t0 = time.perf_counter()
-            _sync(fn(q0))
-            best = min(best, time.perf_counter() - t0)
-        t[n] = best
-    return (t[N2] - t[N1]) / (N2 - N1)
+    return diff_time_scan(make_fn, (q0,), N1, N2, reps=3)
 
 
 def main():

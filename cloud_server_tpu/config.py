@@ -42,16 +42,25 @@ class ModelConfig:
     #  ppermute kv rotation vs all-to-all head re-sharding)
     attention_impl: str = "xla"
     # flash-attention block sizes (the pallas kernel's q/kv tiling).
-    # Smaller blocks enable the block-level causal skip (up to 2x fewer
-    # attention FLOPs) at the cost of more grid steps; 512 measures best
-    # at the S=1024 bench config, 1024 keeps long-sequence VMEM in check.
-    flash_block_q: int = 512
-    flash_block_kv: int = 512
-    # decode-time (cached, single-query) attention: "xla" | "pallas"
+    # Measured v5e sweep (r3, 330M bench, S=1024): 1024 single-block with
+    # the fused whole-sequence backward is optimal at 221 ms/step;
+    # 512-blocks lose BOTH ways despite the causal block skip — 236 ms
+    # with the staged-dq single-recompute backward (staging traffic) and
+    # 242 ms with the two-pass backward (second recompute + grid
+    # overhead). At S=2048/1024-blocks the two-pass backward also edges
+    # the staged one (65.2 vs 67.2 ms) — the backward is bandwidth-bound,
+    # so recompute is cheaper than dq-staging HBM round trips.
+    flash_block_q: int = 1024
+    flash_block_kv: int = 1024
+    # decode-time (cached) attention: "xla" | "pallas". "pallas" selects
+    # the paged-attention kernel and is only meaningful with the paged
+    # serving stack (inference.paged_server); the contiguous engine
+    # always uses the XLA path.
     decode_attention_impl: str = "xla"
-    # KV-cache storage: "model" (cfg.dtype) | "int8" (symmetric per-head
-    # absmax quantization — halves cache memory; works with both decode
-    # impls: "xla" dequantizes outside attention, "pallas" in VMEM)
+    # KV-cache storage: "model" (cfg.dtype) | "int8" (symmetric
+    # per-(position, head) absmax quantization — halves cache memory;
+    # scales fold into the attention einsums / kernel rows, so no
+    # dequantized cache copy is ever materialised)
     kv_cache_dtype: str = "model"
     # mixture of experts (0 experts => dense MLP)
     num_experts: int = 0

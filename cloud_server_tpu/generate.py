@@ -194,14 +194,13 @@ def main(argv=None) -> None:
     elif hf_params is not None:
         params = hf_params
     elif lcfg is not None:
+        base_module = transformer
         if model_cfg.num_experts >= 2:
-            raise SystemExit(
-                "--lora-* flags support the dense family only (LoRA "
-                "adapters wrap the dense transformer, not the MoE stack)")
+            from cloud_server_tpu.models import moe as base_module
+        lora_module = make_lora_module(lcfg, base_module=base_module)
         params = load_params(model_cfg, args.checkpoint_dir, args.step,
-                             args.seed,
-                             loss_fn_module=make_lora_module(lcfg))
-        params = export_merged(params, lcfg)
+                             args.seed, loss_fn_module=lora_module)
+        params = export_merged(params, lcfg, base_module=base_module)
     else:
         moe_module = None
         if model_cfg.num_experts >= 2:

@@ -52,10 +52,11 @@ def _kv_quant(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Symmetric int8 quantization over the last (head_dim) axis.
 
     Per-(position, head) absmax scaling keeps error ~0.5% while halving
-    cache MEMORY vs bf16 — the cap on concurrent slots x context. Note
-    the measured v5e decode cost is ~+20% (the dequantized per-layer
-    copy materialises in HBM; see docs/serving.md) — use when memory,
-    not latency, is the binding constraint."""
+    cache MEMORY vs bf16 — the cap on concurrent slots x context.
+    Decode-time cost: the scales fold into attention scores/probs
+    (`causal_attention(k_scale=...)` and the paged kernel), so no
+    dequantized cache copy is ever materialised; see docs/serving.md for
+    the measured throughput numbers."""
     scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
                     keepdims=True) / 127.0
     scale = jnp.maximum(scale, 1e-8)
@@ -177,26 +178,22 @@ def decode_step(params, token: jnp.ndarray, cfg: ModelConfig,
 
     int8_kv = cfg.kv_cache_dtype == "int8"
     if cfg.decode_attention_impl == "pallas":
-        from cloud_server_tpu.ops.decode_attention import decode_attention
-
-        # int8 caches go to the kernel RAW with their scales — dequant
-        # happens in VMEM, so decode streams half the HBM bytes. (The XLA
-        # path below also consumes int8 raw, folding scales into
-        # scores/probs inside the einsums.)
-        def attend(q, k_cache, v_cache, k_scale=None, v_scale=None):
-            return decode_attention(q, k_cache, v_cache, cache.length + 1,
-                                    k_scale=k_scale, v_scale=v_scale)
-    elif cfg.decode_attention_impl == "xla":
-        # int8 caches: scales fold into scores/probs inside the op, so the
-        # int8 buffers feed the einsums raw — no dequantized HBM copy.
-        def attend(q, k_cache, v_cache, k_scale=None, v_scale=None):
-            return causal_attention(q, k_cache, v_cache,
-                                    q_positions=positions,
-                                    kv_length=cache.length + 1,
-                                    k_scale=k_scale, v_scale=v_scale)
-    else:
+        raise ValueError(
+            "the contiguous engine's pallas decode kernel was removed (it "
+            "measured slower than XLA at every serving shape); "
+            "decode_attention_impl='pallas' selects ops.paged_attention "
+            "in the paged serving stack (inference.paged_server) instead")
+    if cfg.decode_attention_impl != "xla":
         raise ValueError(
             f"unknown decode_attention_impl: {cfg.decode_attention_impl!r}")
+
+    # int8 caches: scales fold into scores/probs inside the op, so the
+    # int8 buffers feed the einsums raw — no dequantized HBM copy.
+    def attend(q, k_cache, v_cache, k_scale=None, v_scale=None):
+        return causal_attention(q, k_cache, v_cache,
+                                q_positions=positions,
+                                kv_length=cache.length + 1,
+                                k_scale=k_scale, v_scale=v_scale)
 
     # Unrolled layer loop with in-place slice updates. A lax.scan with the
     # cache as stacked ys re-materialises the full (L, B, S, KH, Dh) k/v

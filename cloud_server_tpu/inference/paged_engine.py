@@ -118,36 +118,86 @@ def hbm_bytes(cache: PagedKVCache) -> int:
 
 
 def _write_window(cache: PagedKVCache, layer: int, k, v, pos):
-    """Scatter fresh (B, W, KH, Dh) k/v at absolute positions (B, W)
-    through the page table (pages store positions on the minor dim).
-    Out-of-chain positions (sentinel table entries) drop."""
+    """Write fresh (B, W, KH, Dh) k/v at absolute positions (B, W)
+    through the page table. Out-of-chain positions (sentinel table
+    entries) drop.
+
+    Implementation note: a direct elementwise scatter into the transposed
+    (.., Dh, ps) pages would write 2-byte elements at stride ps — an XLA
+    scatter slow path that dominated the decode step when measured. So
+    writes go page-at-a-time instead: GATHER each touched page (a W-token
+    window touches at most 2 consecutive pages per slot — both
+    slot-PRIVATE by the allocator's sharing invariant, so whole-page
+    read-modify-write races nothing), merge the window's positions in
+    with a one-hot lane mask, and SET the whole page back — one
+    single-index scatter of contiguous page blocks, ~2 pages of traffic
+    per slot per layer instead of thousands of strided element writes."""
     ps = cache.page_size
-    page_slot = jnp.clip(pos // ps, 0, cache.tables.shape[1] - 1)
-    pages = jnp.take_along_axis(cache.tables, page_slot, axis=1)  # (B, W)
-    offs = pos % ps
-    if cache.k_scale is not None:
+    b, w = pos.shape
+    max_slot = cache.tables.shape[1] - 1
+    int8 = cache.k_scale is not None
+    if int8:
         kq, ksc = _kv_quant(k)
         vq, vsc = _kv_quant(v)
-        return cache._replace(
-            k=cache.k.at[layer, pages, :, :, offs].set(
-                kq.astype(cache.k.dtype), mode="drop"),
-            v=cache.v.at[layer, pages, :, :, offs].set(
-                vq.astype(cache.v.dtype), mode="drop"),
-            k_scale=cache.k_scale.at[layer, pages, :, offs].set(
-                ksc[..., 0], mode="drop"),
-            v_scale=cache.v_scale.at[layer, pages, :, offs].set(
-                vsc[..., 0], mode="drop"))
-    return cache._replace(
-        k=cache.k.at[layer, pages, :, :, offs].set(
-            k.astype(cache.k.dtype), mode="drop"),
-        v=cache.v.at[layer, pages, :, :, offs].set(
-            v.astype(cache.v.dtype), mode="drop"))
+        k_src = kq.astype(cache.k.dtype)
+        v_src = vq.astype(cache.v.dtype)
+    else:
+        k_src = k.astype(cache.k.dtype)
+        v_src = v.astype(cache.v.dtype)
+
+    new = {"k": cache.k, "v": cache.v,
+           "k_scale": cache.k_scale, "v_scale": cache.v_scale}
+    # a W-token window starting mid-page touches ceil(W/ps)+1 consecutive
+    # page slots; W=1 touches exactly one
+    n_groups = 1 if w == 1 else (-(-w // ps) + 1)
+    first_slot = jnp.clip(pos[:, 0] // ps, 0, max_slot)  # (B,)
+    lane = jnp.arange(ps)
+    for g in range(n_groups):
+        slot_g = jnp.clip(first_slot + g, 0, max_slot)
+        page_g = jnp.take_along_axis(cache.tables, slot_g[:, None],
+                                     axis=1)[:, 0]          # (B,)
+        in_page = (pos // ps) == slot_g[:, None]            # (B, W)
+        # one-hot over lanes for each window position in this page
+        oh = (in_page[:, :, None]
+              & (lane[None, None, :] == (pos % ps)[:, :, None]))  # (B,W,ps)
+        ohf = oh.astype(jnp.float32)
+        any_write = ohf.sum(axis=1)                          # (B, ps)
+        for name, src in (("k", k_src), ("v", v_src)):
+            pool = new[name]
+            pages_old = pool[layer, jnp.clip(page_g, 0, pool.shape[1] - 1)]
+            upd = jnp.einsum("bwhd,bwp->bhdp",
+                             src.astype(jnp.float32), ohf)
+            merged = (pages_old.astype(jnp.float32)
+                      * (1.0 - any_write[:, None, None, :]) + upd)
+            new[name] = pool.at[layer, page_g].set(
+                merged.astype(pool.dtype), mode="drop")
+        if int8:
+            for name, sc in (("k_scale", ksc), ("v_scale", vsc)):
+                spool = new[name]
+                sp_old = spool[layer, jnp.clip(page_g, 0,
+                                               spool.shape[1] - 1)]
+                upd = jnp.einsum("bwh,bwp->bhp", sc[..., 0], ohf)
+                merged = sp_old * (1.0 - any_write[:, None, :]) + upd
+                new[name] = spool.at[layer, page_g].set(merged,
+                                                        mode="drop")
+    return cache._replace(k=new["k"], v=new["v"],
+                          k_scale=new["k_scale"], v_scale=new["v_scale"])
+
+
+# Widest window the pallas kernel serves: its whole-batch q/o VMEM blocks
+# scale with B*W (B=8, W=64 already ~2 MB each next to the 16 MB scoped
+# limit). Wider windows (prefill chunks) take the XLA gather path — at
+# W >= page_size the dense W x S matmuls have real arithmetic intensity
+# and the per-layer gather amortises over the window, which is exactly
+# where XLA is strong; the kernel exists for the thin decode/verify
+# windows where gathers would dominate.
+_PALLAS_MAX_W = 32
 
 
 def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
                    cache: PagedKVCache, *, logits_at: jnp.ndarray | None,
                    all_logits: bool = False,
-                   pages_per_block: int = 8):
+                   pages_per_block: int | None = None):
     """Forward W new positions per slot against the paged cache.
 
     Args:
@@ -168,7 +218,12 @@ def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
     cos, sin = rope_table(cfg, cache.max_context)
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]  # (B, W, D)
 
-    use_pallas = cfg.decode_attention_impl == "pallas"
+    use_pallas = (cfg.decode_attention_impl == "pallas"
+                  and w <= _PALLAS_MAX_W)
+    if pages_per_block is None:
+        # wider windows leave less VMEM for the double-buffered page
+        # blocks; 8 pages measured fastest at W=1 on v5e
+        pages_per_block = 8 if w <= 8 else 4
     lens_after = cache.lengths + w
 
     for layer_idx in range(cfg.num_layers):

@@ -361,6 +361,7 @@ class PagedInferenceServer:
         # round (mean accepted length + 1); plain decode reports ~1.0
         self.decode_rounds = 0
         self.decode_tokens_committed = 0
+        self.tokens_emitted = 0  # lifetime emitted tokens (bench/metrics)
 
         self._slots: list[_Slot | None] = [None] * max_slots
         self._jobs: list[_AdmitJob] = []
@@ -423,6 +424,7 @@ class PagedInferenceServer:
             req.finish_reason = "eos"
             return True
         req.tokens.append(token)
+        self.tokens_emitted += 1
         req.logprobs.append(float(logprob))
         if req.stream is not None:
             req.stream(token)

@@ -358,6 +358,7 @@ class InferenceServer:
             rcap = min(max_len - len(pfx), prefix_remainder_cap)
             self._rem_buckets = ([b for b in self.prompt_buckets
                                   if b < rcap] + [rcap])
+        self.tokens_emitted = 0  # lifetime emitted tokens (bench/metrics)
         self._slots: list[Request | None] = [None] * max_slots
         self._pending: collections.deque[Request] = collections.deque()
         self._lock = threading.Lock()
@@ -417,6 +418,7 @@ class InferenceServer:
             req.finish_reason = "eos"
             return True
         req.tokens.append(token)
+        self.tokens_emitted += 1
         if logprob is not None:
             # append before stream(): a consumer woken by the stream
             # callback may read logprobs[len(tokens)-1]

@@ -38,16 +38,38 @@ from jax import lax
 from cloud_server_tpu.config import ModelConfig
 from cloud_server_tpu.models import transformer
 
-# target name -> number of trailing output dims in the base weight
-# (everything between the layer axis and the output dims is fan-in)
-_TARGETS: dict[str, int] = {
-    "wq": 2, "wk": 2, "wv": 2,  # (L, D, H, Dh): out = (H, Dh)
-    "wo": 1,                     # (L, H, Dh, D): out = (D,)
-    "w_gate": 1, "w_up": 1,      # (L, D, F)
-    "w_down": 1,                 # (L, F, D)
+# target name -> (stack axis names between the layer axis and fan-in,
+# number of trailing output dims). Everything between the stack axes and
+# the output dims is fan-in; adapters get one (A, B) pair per stack entry
+# — for MoE expert weights (L, E, D, F) that means PER-EXPERT adapters
+# A (L, E, D, r), B (L, E, r, F).
+_DENSE_TARGETS: dict[str, tuple[tuple[str, ...], int]] = {
+    "wq": ((), 2), "wk": ((), 2), "wv": ((), 2),  # (L, D, H, Dh)
+    "wo": ((), 1),                                 # (L, H, Dh, D)
+    "w_gate": ((), 1), "w_up": ((), 1),            # (L, D, F)
+    "w_down": ((), 1),                             # (L, F, D)
 }
+_MOE_TARGETS: dict[str, tuple[tuple[str, ...], int]] = {
+    "wq": ((), 2), "wk": ((), 2), "wv": ((), 2),
+    "wo": ((), 1),
+    "router": ((), 1),                             # (L, D, E)
+    "w_gate": (("experts",), 1),                   # (L, E, D, F)
+    "w_up": (("experts",), 1),
+    "w_down": (("experts",), 1),                   # (L, E, F, D)
+}
+_TARGETS = {**_DENSE_TARGETS, **_MOE_TARGETS}  # union, for validation
 
 DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def _target_table(base_module) -> dict[str, tuple[tuple[str, ...], int]]:
+    if base_module is transformer:
+        return _DENSE_TARGETS
+    from cloud_server_tpu.models import moe
+    if base_module is moe:
+        return _MOE_TARGETS
+    raise NotImplementedError(
+        f"LoRA target table not defined for module {base_module!r}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,60 +130,82 @@ def lora_config_from_args(args) -> LoRAConfig | None:
                       targets=tuple(args.lora_targets.split(",")))
 
 
-def _split_dims(name: str, shape: tuple[int, ...]) -> tuple[int, int]:
-    """(fan_in, fan_out) of a stacked (L, ...) base weight, flattened."""
-    n_out = _TARGETS[name]
-    fan_in = math.prod(shape[1:-n_out])
+def _split_dims(name: str, shape: tuple[int, ...], table=None
+                ) -> tuple[tuple[int, ...], int, int]:
+    """(stack dims, fan_in, fan_out) of a stacked (L, *stack, ...) base
+    weight, fan-in/out flattened."""
+    stack_axes, n_out = (table or _DENSE_TARGETS)[name]
+    n_stack = len(stack_axes)
+    stack = shape[1:1 + n_stack]
+    fan_in = math.prod(shape[1 + n_stack:-n_out])
     fan_out = math.prod(shape[-n_out:])
-    return fan_in, fan_out
+    return stack, fan_in, fan_out
 
 
 def init_lora_params(model_cfg: ModelConfig, lora_cfg: LoRAConfig,
-                     rng: jax.Array) -> dict:
-    """A ~ N(0, 1/fan_in), B = 0 — the adapted delta starts at exactly 0."""
-    shapes = transformer.param_shapes(model_cfg)["layers"]
+                     rng: jax.Array, base_module=transformer) -> dict:
+    """A ~ N(0, 1/fan_in), B = 0 — the adapted delta starts at exactly 0.
+    Stacked targets (MoE expert weights) get one adapter pair per stack
+    entry: A (L, E, fan_in, r), B (L, E, r, fan_out)."""
+    table = _target_table(base_module)
+    bad = set(lora_cfg.targets) - set(table)
+    if bad:
+        raise ValueError(
+            f"LoRA targets {sorted(bad)} do not exist for this model "
+            f"family (valid here: {sorted(table)})")
+    shapes = base_module.param_shapes(model_cfg)["layers"]
     keys = jax.random.split(rng, len(lora_cfg.targets))
     out: dict[str, Any] = {"layers": {}}
     for key, name in zip(keys, sorted(lora_cfg.targets)):
         L = shapes[name][0]
-        fan_in, fan_out = _split_dims(name, shapes[name])
+        stack, fan_in, fan_out = _split_dims(name, shapes[name], table)
         a = (jax.random.truncated_normal(
-            key, -2.0, 2.0, (L, fan_in, lora_cfg.rank), jnp.float32)
+            key, -2.0, 2.0, (L, *stack, fan_in, lora_cfg.rank),
+            jnp.float32)
             / math.sqrt(fan_in)).astype(jnp.dtype(model_cfg.param_dtype))
-        b = jnp.zeros((L, lora_cfg.rank, fan_out),
+        b = jnp.zeros((L, *stack, lora_cfg.rank, fan_out),
                       jnp.dtype(model_cfg.param_dtype))
         out["layers"][name] = {"a": a, "b": b}
     return out
 
 
-def lora_logical_axes(model_cfg: ModelConfig, lora_cfg: LoRAConfig) -> dict:
-    return {"layers": {name: {"a": ("layers", None, None),
-                              "b": ("layers", None, None)}
-                       for name in sorted(lora_cfg.targets)}}
+def lora_logical_axes(model_cfg: ModelConfig, lora_cfg: LoRAConfig,
+                      base_module=transformer) -> dict:
+    table = _target_table(base_module)
+    out = {}
+    for name in sorted(lora_cfg.targets):
+        stack_axes = table[name][0]
+        out[name] = {"a": ("layers", *stack_axes, None, None),
+                     "b": ("layers", *stack_axes, None, None)}
+    return {"layers": out}
 
 
 def merge_lora(base: dict, lora: dict, lora_cfg: LoRAConfig,
-               dtype=None) -> dict:
-    """base params + scale·A@B on each target; structure-preserving."""
+               dtype=None, base_module=transformer) -> dict:
+    """base params + scale·A@B on each target; structure-preserving and
+    shape-generic (stacked targets merge per stack entry — per expert for
+    MoE). Family validation happens at init; `base_module` is accepted
+    for API symmetry."""
+    del base_module
     merged_layers = dict(base["layers"])
     for name, ab in lora["layers"].items():
         w = base["layers"][name]
         compute = jnp.dtype(dtype) if dtype is not None else w.dtype
-        L = w.shape[0]
-        fan_in, fan_out = _split_dims(name, w.shape)
         delta = jnp.einsum(
-            "lir,lro->lio", ab["a"].astype(compute),
+            "...ir,...ro->...io", ab["a"].astype(compute),
             ab["b"].astype(compute)) * lora_cfg.scale
         merged_layers[name] = (
-            w + delta.reshape((L,) + w.shape[1:]).astype(w.dtype))
+            w + delta.reshape(w.shape).astype(w.dtype))
     out = dict(base)
     out["layers"] = merged_layers
     return out
 
 
-def export_merged(params: dict, lora_cfg: LoRAConfig) -> dict:
+def export_merged(params: dict, lora_cfg: LoRAConfig,
+                  base_module=transformer) -> dict:
     """{"base","lora"} TrainState params -> plain servable base params."""
-    return merge_lora(params["base"], params["lora"], lora_cfg)
+    return merge_lora(params["base"], params["lora"], lora_cfg,
+                      base_module=base_module)
 
 
 def make_lora_module(lora_cfg: LoRAConfig, base_module=transformer,
@@ -177,11 +221,7 @@ def make_lora_module(lora_cfg: LoRAConfig, base_module=transformer,
     drops into `make_train_step` / `train_loop` / `Checkpointer` via their
     `loss_fn_module` argument — the same extension seam `models.moe` uses.
     """
-    if base_module is not transformer:
-        raise NotImplementedError(
-            "LoRA currently adapts the dense transformer family only "
-            "(MoE expert matrices are (L, E, ...)-stacked; a per-expert "
-            "adapter layout is future work)")
+    _target_table(base_module)  # raises for unknown module families
 
     class module:
         lora_config = lora_cfg
@@ -192,12 +232,13 @@ def make_lora_module(lora_cfg: LoRAConfig, base_module=transformer,
             base = (base_params if base_params is not None
                     else base_module.init_params(cfg, rng_base))
             return {"base": base,
-                    "lora": init_lora_params(cfg, lora_cfg, rng_lora)}
+                    "lora": init_lora_params(cfg, lora_cfg, rng_lora,
+                                             base_module)}
 
         @staticmethod
         def param_logical_axes(cfg: ModelConfig) -> dict:
             return {"base": base_module.param_logical_axes(cfg),
-                    "lora": lora_logical_axes(cfg, lora_cfg)}
+                    "lora": lora_logical_axes(cfg, lora_cfg, base_module)}
 
         @staticmethod
         def param_labels(cfg: ModelConfig) -> dict:
@@ -205,15 +246,17 @@ def make_lora_module(lora_cfg: LoRAConfig, base_module=transformer,
             return {"base": jax.tree.map(lambda _: "frozen",
                                          base_module.param_logical_axes(cfg),
                                          is_leaf=lambda x: isinstance(x, tuple)),
-                    "lora": jax.tree.map(lambda _: "trainable",
-                                         lora_logical_axes(cfg, lora_cfg),
-                                         is_leaf=lambda x: isinstance(x, tuple))}
+                    "lora": jax.tree.map(
+                        lambda _: "trainable",
+                        lora_logical_axes(cfg, lora_cfg, base_module),
+                        is_leaf=lambda x: isinstance(x, tuple))}
 
         @staticmethod
         def next_token_loss(params: dict, batch: dict, cfg: ModelConfig,
                             **kwargs):
             frozen = jax.tree.map(lax.stop_gradient, params["base"])
-            merged = merge_lora(frozen, params["lora"], lora_cfg)
+            merged = merge_lora(frozen, params["lora"], lora_cfg,
+                                base_module=base_module)
             return base_module.next_token_loss(merged, batch, cfg, **kwargs)
 
     return module

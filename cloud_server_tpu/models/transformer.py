@@ -244,10 +244,31 @@ def _packed_attention_fn(cfg: ModelConfig, segment_ids):
         return partial(flash_attention, segment_ids=segment_ids,
                        block_q=cfg.flash_block_q,
                        block_kv=cfg.flash_block_kv)
-    raise ValueError(
-        f"packed segment_ids support requires attention_impl 'xla' or "
-        f"'flash' (got {cfg.attention_impl!r}); the ring/ulysses "
-        "sequence-parallel paths do not take a segment mask yet")
+    if cfg.attention_impl == "ring":
+        from cloud_server_tpu.parallel.mesh import current_mesh
+        from cloud_server_tpu.parallel.ring_attention import (
+            ring_attention_sharded)
+
+        mesh = current_mesh()
+
+        def ring_fn(q, k, v):
+            return ring_attention_sharded(q, k, v, mesh,
+                                          segment_ids=segment_ids)
+
+        return ring_fn
+    if cfg.attention_impl == "ulysses":
+        from cloud_server_tpu.parallel.mesh import current_mesh
+        from cloud_server_tpu.parallel.ulysses import (
+            ulysses_attention_sharded)
+
+        mesh = current_mesh()
+
+        def ulysses_fn(q, k, v):
+            return ulysses_attention_sharded(q, k, v, mesh,
+                                             segment_ids=segment_ids)
+
+        return ulysses_fn
+    raise ValueError(f"unknown attention_impl: {cfg.attention_impl!r}")
 
 
 def apply_segment_loss_mask(batch: dict) -> dict:

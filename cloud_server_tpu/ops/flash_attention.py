@@ -351,9 +351,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
 
 
 # Largest kv-block count for which the single-pass backward may stage dq
-# partials ((b, h, nkv, sq, d) f32 — nkv x dq-bytes of HBM). Above this,
-# dq runs as its own q-stationary pass instead.
-_DQ_STAGE_MAX_NKV = 8
+# partials ((b, h, nkv, sq, d) f32 — nkv x dq-bytes of HBM). Measured on
+# v5e (r3): the staged path LOSES to the two-pass recompute at every
+# shape tried (S=2048/1024-blocks: 67.2 vs 65.2 ms; S=1024/512-blocks:
+# 236 vs 242 — both behind the 221 ms fused single-block path) because
+# the backward is bandwidth-bound and staging trades MXU recompute for
+# HBM round trips. Kept at 0 (two-pass default); the staged path remains
+# selectable here for hardware where compute, not bandwidth, binds.
+_DQ_STAGE_MAX_NKV = 0
 
 
 # ---------------------------------------------------------------------------

@@ -125,34 +125,47 @@ def _is_moe_module(loss_fn_module) -> bool:
     return hasattr(loss_fn_module, "_moe_block")
 
 
-def _dense_stage_factory(model_cfg, cos, sin, attn_fn):
-    def stage_fn(stage_params, x):
+def _dense_stage_factory(model_cfg, cos, sin, attn_fn, packed=False):
+    def stage_fn(stage_params, payload):
+        if packed:
+            # segment ids + per-document positions ride the ring with the
+            # activations so every stage masks/ropes its microbatch right
+            x, seg, pos = payload
+            attn = transformer._packed_attention_fn(model_cfg, seg)
+        else:
+            x, seg, pos, attn = payload, None, None, attn_fn
         block = functools.partial(transformer._block, cfg=model_cfg,
-                                  cos=cos, sin=sin, attn_fn=attn_fn)
+                                  cos=cos, sin=sin, attn_fn=attn,
+                                  positions=pos)
         block = transformer.apply_remat(block, model_cfg)
 
         def scan_body(h, lp):
             return block(h, lp), None
 
         out, _ = lax.scan(scan_body, x, stage_params)
-        return out
+        return (out, seg, pos) if packed else out
     return stage_fn
 
 
-def _moe_stage_factory(model_cfg, cos, sin, attn_fn):
+def _moe_stage_factory(model_cfg, cos, sin, attn_fn, packed=False):
     """MoE stage: payload is (x, aux3) — the three router stats
     (load_balance, router_z, dropped_frac) accumulate across layers and
     ride the ring with the activations."""
     from cloud_server_tpu.models import moe
 
     def stage_fn(stage_params, payload):
-        x, aux3 = payload
+        if packed:
+            x, aux3, seg, pos = payload
+            attn = transformer._packed_attention_fn(model_cfg, seg)
+        else:
+            (x, aux3), seg, pos, attn = payload, None, None, attn_fn
         # aux3 enters replicated over the batch axes while x is sharded
         # over them; the scan carry must agree, so promote aux3 to x's vma.
         aux3 = collectives.pvary(aux3, tuple(
             set(jax.typeof(x).vma) - set(jax.typeof(aux3).vma)))
         block = functools.partial(moe._moe_block, cfg=model_cfg,
-                                  cos=cos, sin=sin, attn_fn=attn_fn)
+                                  cos=cos, sin=sin, attn_fn=attn,
+                                  positions=pos)
         block = transformer.apply_remat(block, model_cfg)
 
         def scan_body(carry, lp):
@@ -163,7 +176,7 @@ def _moe_stage_factory(model_cfg, cos, sin, attn_fn):
             return (h, a), None
 
         (x, aux3), _ = lax.scan(scan_body, (x, aux3), stage_params)
-        return x, aux3
+        return (x, aux3, seg, pos) if packed else (x, aux3)
     return stage_fn
 
 
@@ -192,30 +205,51 @@ def make_pipelined_hidden(model_cfg, mesh: Mesh, num_microbatches: int,
     layer_spec = P("pp")  # stacked layer axis sharded over pp
     batch_spec = P(rules["batch"])
 
-    def hidden(params, tokens):
+    def hidden(params, tokens, segment_ids=None):
         cfg = model_cfg
+        packed = segment_ids is not None
+        if packed and cfg.attention_impl not in ("xla", "flash"):
+            raise ValueError(
+                "pipelined packed batches need attention_impl 'xla' or "
+                "'flash' (ring/ulysses would nest shard_map inside the "
+                f"pipeline shard_map); got {cfg.attention_impl!r}")
         cos, sin = rope_table(cfg, tokens.shape[1])
         x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]  # (B, S, D)
         b = x.shape[0]
         mb = b // num_microbatches
         micro_x = x.reshape((num_microbatches, mb) + x.shape[1:])
+        seg_pos_specs = ()
+        seg_pos = ()
+        if packed:
+            from cloud_server_tpu.ops.segments import positions_from_segments
+            pos = positions_from_segments(segment_ids)
+            mshape = (num_microbatches, mb, tokens.shape[1])
+            seg_pos = (segment_ids.reshape(mshape), pos.reshape(mshape))
+            seg_pos_specs = (P(None, *batch_spec[:1], None),
+                             P(None, *batch_spec[:1], None))
         if is_moe:
-            micro = (micro_x, jnp.zeros((num_microbatches, 3), jnp.float32))
-            payload_spec = (P(None, *batch_spec), P(None, None))
+            micro = (micro_x, jnp.zeros((num_microbatches, 3), jnp.float32),
+                     *seg_pos)
+            payload_spec = (P(None, *batch_spec), P(None, None),
+                            *seg_pos_specs)
+            if not packed:
+                micro = micro[:2]
         else:
-            micro = micro_x
-            payload_spec = P(None, *batch_spec)
+            micro = (micro_x, *seg_pos) if packed else micro_x
+            payload_spec = ((P(None, *batch_spec), *seg_pos_specs)
+                            if packed else P(None, *batch_spec))
 
-        attn_fn = transformer._get_attention_fn(cfg)
-        stage_fn = factory(cfg, cos, sin, attn_fn)
+        attn_fn = None if packed else transformer._get_attention_fn(cfg)
+        stage_fn = factory(cfg, cos, sin, attn_fn, packed=packed)
 
         def pipe_fn(layers, micro_in):
             out = pipeline_spmd(layers, micro_in, stage_fn=stage_fn)
             if is_moe:
-                xo, a = out
-                # router stats are per-batch-shard; average them so the
-                # replicated out_spec is truthful
-                return xo, lax.pmean(a, rules["batch"])
+                # payload may carry (x, aux3[, seg, pos]); router stats
+                # are per-batch-shard, averaged so the replicated
+                # out_spec is truthful
+                return (out[0], lax.pmean(out[1], rules["batch"]),
+                        *out[2:])
             return out
 
         pipe = jax.shard_map(
@@ -227,6 +261,8 @@ def make_pipelined_hidden(model_cfg, mesh: Mesh, num_microbatches: int,
             check_vma=True,
         )
         micro_out = pipe(params["layers"], micro)
+        if packed:
+            micro_out = (micro_out[:2] if is_moe else micro_out[0])
         if is_moe:
             micro_x_out, aux_out = micro_out
             xo = rms_norm(micro_x_out.reshape(x.shape),
@@ -250,11 +286,12 @@ def make_pipelined_forward(model_cfg, mesh: Mesh, num_microbatches: int,
                                    loss_fn_module)
     is_moe = _is_moe_module(loss_fn_module)
 
-    def forward(params, tokens):
+    def forward(params, tokens, segment_ids=None):
         if is_moe:
-            x, aux = hidden(params, tokens)
+            x, aux = hidden(params, tokens, segment_ids)
             return transformer.unembed(x, params, model_cfg), aux
-        return transformer.unembed(hidden(params, tokens), params, model_cfg)
+        return transformer.unembed(hidden(params, tokens, segment_ids),
+                                   params, model_cfg)
 
     return forward
 
@@ -280,13 +317,9 @@ def make_pipelined_loss(model_cfg, mesh: Mesh, num_microbatches: int,
         # runtime cfg so the head/softcap/chunking can't silently diverge
         # from the pipelined body.
         del cfg
-        if batch.get("segment_ids") is not None:
-            raise ValueError(
-                "packed batches (segment_ids) are not supported by the "
-                "pipelined loss yet — attention would silently cross "
-                "document boundaries; train packed batches with the "
-                "unpipelined path")
-        out = hidden(params, batch["tokens"])
+        seg = batch.get("segment_ids")
+        batch = transformer.apply_segment_loss_mask(batch)
+        out = hidden(params, batch["tokens"], seg)
         x, aux = out if is_moe else (out, None)
         if model_cfg.vocab_chunk > 0:
             loss, metrics = transformer.fused_cross_entropy(

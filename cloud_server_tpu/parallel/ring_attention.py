@@ -30,12 +30,16 @@ from cloud_server_tpu.parallel import collectives
 NEG_INF = -1e30
 
 
-def _chunk_merge(carry, q, k, v, q_off, kv_off, scale):
+def _chunk_merge(carry, q, k, v, q_off, kv_off, scale, seg_q=None,
+                 seg_kv=None):
     """Fold one visiting kv chunk into the online-softmax accumulators.
 
     carry: (acc (B,KH,G,Sq,Dh) f32, m (B,KH,G,Sq,1) f32, l same).
     q: (B, Sq, H, Dh); k, v: (B, Skv, KH, Dh).
     q_off / kv_off: absolute position of element 0 of each chunk (traced).
+    seg_q / seg_kv: optional (B, Sq) / (B, Skv) packed-segment ids — the
+    visiting chunk's ids rotate around the ring with it, so cross-chunk
+    attention is additionally masked to same-segment pairs.
     """
     acc, m, l = carry
     b, sq, h, dh = q.shape
@@ -49,6 +53,9 @@ def _chunk_merge(carry, q, k, v, q_off, kv_off, scale):
     q_pos = q_off + jnp.arange(sq)
     kv_pos = kv_off + jnp.arange(skv)
     mask = (q_pos[:, None] >= kv_pos[None, :])[None, None, None]  # (1,1,1,Sq,Skv)
+    if seg_q is not None:
+        same = (seg_q[:, :, None] == seg_kv[:, None, :])  # (B, Sq, Skv)
+        mask = jnp.logical_and(mask, same[:, None, None])
     s = jnp.where(mask, s, NEG_INF)
 
     m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -65,12 +72,18 @@ def _chunk_merge(carry, q, k, v, q_off, kv_off, scale):
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   segment_ids: jnp.ndarray | None = None,
                    *, axis_name: str = "sp", scale: float | None = None):
     """Causal GQA over a sequence sharded on `axis_name`. Call under shard_map.
 
     q: (B, Sq_local, H, Dh); k, v: (B, Skv_local, KH, Dh) — the local chunks.
     Chunks are assumed laid out in ring order: device i holds positions
     [i * Sq_local, (i+1) * Sq_local).
+
+    segment_ids: optional (B, Sq_local) packed-sequence ids, sharded over
+    the sequence exactly like the tokens. The kv chunk's ids rotate with
+    it, so the block-diagonal packed mask is exact across chunk
+    boundaries.
 
     Returns the local output chunk (B, Sq_local, H, Dh).
     """
@@ -83,6 +96,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     q_off = idx * sq
+    has_seg = segment_ids is not None
 
     # Fresh accumulators are unvarying; inside shard_map they must carry
     # the same varying-manual-axes (vma) set as the chunks they accumulate,
@@ -92,29 +106,44 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     m = collectives.pvary(jnp.full((b, kh, g, sq, 1), NEG_INF, jnp.float32), vma)
     l = collectives.pvary(jnp.zeros((b, kh, g, sq, 1), jnp.float32), vma)
 
-    def body(t, state):
-        acc, m, l, kc, vc = state
-        src = (idx - t) % n  # who this kv chunk belongs to
-        acc, m, l = _chunk_merge((acc, m, l), q, kc, vc,
-                                 q_off, src * skv, scale)
-        kc, vc = collectives.ring_exchange((kc, vc), axis_name)
-        return acc, m, l, kc, vc
+    def merge(carry, kc, vc, segc, kv_off):
+        return _chunk_merge(carry, q, kc, vc, q_off, kv_off, scale,
+                            segment_ids if has_seg else None,
+                            segc if has_seg else None)
 
+    def body(t, state):
+        acc, m, l, kc, vc, segc = state
+        src = (idx - t) % n  # who this kv chunk belongs to
+        acc, m, l = merge((acc, m, l), kc, vc, segc, src * skv)
+        kc, vc, segc = collectives.ring_exchange((kc, vc, segc), axis_name)
+        return acc, m, l, kc, vc, segc
+
+    # the rotating segment chunk; a dummy rides the ring when unpacked so
+    # the loop structure is uniform
+    seg0 = (segment_ids if has_seg
+            else collectives.pvary(jnp.zeros((b, skv), jnp.int32), vma))
     # n-1 fold+rotate steps, then a final fold with no wasted rotation.
-    acc, m, l, kc, vc = lax.fori_loop(0, n - 1, body, (acc, m, l, k, v))
-    acc, m, l = _chunk_merge((acc, m, l), q, kc, vc,
-                             q_off, ((idx - (n - 1)) % n) * skv, scale)
+    acc, m, l, kc, vc, segc = lax.fori_loop(
+        0, n - 1, body, (acc, m, l, k, v, seg0))
+    acc, m, l = merge((acc, m, l), kc, vc, segc,
+                      ((idx - (n - 1)) % n) * skv)
     out = acc / jnp.maximum(l, 1e-30)  # (B, KH, G, Sq, Dh)
     return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, h, dh).astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh, *, scale=None,
+def ring_attention_sharded(q, k, v, mesh, *, segment_ids=None, scale=None,
                            batch_axes=("dp", "fsdp"), seq_axis="sp",
                            head_axis="tp"):
     """shard_map wrapper: full (B, S, H, Dh) arrays in, ring attention over
-    the sp axis, full arrays out (still sharded by the same specs)."""
+    the sp axis, full arrays out (still sharded by the same specs).
+    segment_ids (B, S) shard over the sequence like the tokens."""
     qspec = P(batch_axes, seq_axis, head_axis, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis, scale=scale)
+    if segment_ids is None:
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
+            check_vma=True)(q, k, v)
+    sspec = P(batch_axes, seq_axis)
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
-        check_vma=True)(q, k, v)
+        fn, mesh=mesh, in_specs=(qspec, qspec, qspec, sspec),
+        out_specs=qspec, check_vma=True)(q, k, v, segment_ids)

@@ -42,16 +42,23 @@ from cloud_server_tpu.parallel import collectives
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      segment_ids: jnp.ndarray | None = None,
                       *, axis_name: str = "sp", scale: float | None = None):
     """Causal GQA over a sequence sharded on `axis_name`. Call under shard_map.
 
     q: (B, Sq_local, H, Dh); k, v: (B, Skv_local, KH, Dh) — the local
     chunks, in ring order (device i holds positions
-    [i * Sq_local, (i+1) * Sq_local)). Returns (B, Sq_local, H, Dh).
+    [i * Sq_local, (i+1) * Sq_local)). segment_ids: optional
+    (B, Sq_local) packed ids sharded like the tokens — after the
+    all-to-all every device attends over the FULL sequence, so the ids
+    are all-gathered (B*S ints — negligible next to the kv all-to-all)
+    and applied as the block-diagonal packed mask. Returns
+    (B, Sq_local, H, Dh).
     """
     sp = lax.axis_size(axis_name)
     if sp == 1:
-        return causal_attention(q, k, v, scale=scale)
+        return causal_attention(q, k, v, scale=scale,
+                                segment_ids=segment_ids)
     h, kh = q.shape[2], k.shape[2]
     if h % sp:
         raise ValueError(
@@ -70,21 +77,31 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                                  split_axis=2, concat_axis=1)
     q_full, k_full, v_full = to_heads(q), to_heads(k), to_heads(v)
 
-    out = causal_attention(q_full, k_full, v_full, scale=scale)
+    seg_full = (None if segment_ids is None
+                else collectives.all_gather(segment_ids, axis_name,
+                                            tiled_axis=1))
+    out = causal_attention(q_full, k_full, v_full, scale=scale,
+                           segment_ids=seg_full)
 
     # head-sharded -> sequence-sharded: (B, S, H/sp, Dh) -> (B, S/sp, H, Dh)
     return collectives.all_to_all(out, axis=axis_name,
                                   split_axis=1, concat_axis=2)
 
 
-def ulysses_attention_sharded(q, k, v, mesh, *, scale=None,
-                              batch_axes=("dp", "fsdp"), seq_axis="sp",
-                              head_axis="tp"):
+def ulysses_attention_sharded(q, k, v, mesh, *, segment_ids=None,
+                              scale=None, batch_axes=("dp", "fsdp"),
+                              seq_axis="sp", head_axis="tp"):
     """shard_map wrapper: full (B, S, H, Dh) arrays in, Ulysses attention
     over the sp axis, full arrays out (still sharded by the same specs).
-    Drop-in alternative to `ring_attention_sharded`."""
+    Drop-in alternative to `ring_attention_sharded`; segment_ids (B, S)
+    shard over the sequence like the tokens."""
     qspec = P(batch_axes, seq_axis, head_axis, None)
     fn = functools.partial(ulysses_attention, axis_name=seq_axis, scale=scale)
+    if segment_ids is None:
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
+            check_vma=True)(q, k, v)
+    sspec = P(batch_axes, seq_axis)
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
-        check_vma=True)(q, k, v)
+        fn, mesh=mesh, in_specs=(qspec, qspec, qspec, sspec),
+        out_specs=qspec, check_vma=True)(q, k, v, segment_ids)

@@ -158,8 +158,6 @@ def main(argv=None) -> None:
         raise SystemExit("--init-from currently requires --lora-rank "
                          "(full-model warm start is not wired up yet)")
     if args.lora_rank > 0:
-        if model_cfg.num_experts >= 2:
-            raise SystemExit("LoRA supports the dense family only")
         from cloud_server_tpu.models.lora import (
             lora_config_from_args, make_lora_module, save_lora_config)
         from cloud_server_tpu.parallel.mesh import make_mesh
@@ -172,7 +170,10 @@ def main(argv=None) -> None:
             base_params = load_params(
                 model_cfg, args.init_from, None, train_cfg.seed,
                 mesh=mesh if mesh is not None else make_mesh(mesh_cfg))
-        loss_fn_module = make_lora_module(lcfg, base_params=base_params)
+        # dense OR MoE: the lora module generalises over the base family
+        # (per-expert adapter stacks for the (L, E, ...) expert weights)
+        loss_fn_module = make_lora_module(
+            lcfg, base_module=loss_fn_module, base_params=base_params)
         if loop_cfg.checkpoint_dir:
             from cloud_server_tpu.parallel.distributed import is_primary
             if is_primary():  # shared ckpt dir: N writers would race

@@ -102,43 +102,3 @@ def test_speculative_with_int8_cache(devices8):
         params, params, prompt, jax.random.key(2), cfg=INT8,
         draft_cfg=INT8, infer_cfg=icfg, num_draft=3))
     np.testing.assert_array_equal(got, want)
-
-
-def test_pallas_decode_int8_matches_xla():
-    """The pallas decode kernel dequantizes int8 caches in VMEM; its
-    logits must track the XLA dequant-outside path closely."""
-    params = transformer.init_params(BASE, jax.random.key(0))
-    tokens = jnp.asarray([[5, 9, 3, 17, 6, 2]], jnp.int32)
-    outs = {}
-    for impl in ("xla", "pallas"):
-        cfg = dataclasses.replace(INT8, decode_attention_impl=impl)
-        cache = init_cache(cfg, 1, 32)
-        _, cache = prefill(params, tokens, cfg, cache)
-        logits, _ = engine.decode_step(
-            params, jnp.asarray([7], jnp.int32), cfg, cache)
-        outs[impl] = np.asarray(logits)
-    np.testing.assert_allclose(outs["pallas"], outs["xla"], atol=2e-3)
-
-
-def test_decode_attention_int8_op_parity():
-    """Op level: decode_attention with an int8 cache + scales vs the XLA
-    reference on the dequantized cache (interpret mode)."""
-    from cloud_server_tpu.inference.engine import _kv_quant
-    from cloud_server_tpu.ops.attention import causal_attention
-    from cloud_server_tpu.ops.decode_attention import decode_attention
-
-    b, s, h, kh, d = 2, 40, 4, 2, 8
-    kq_, kk, kv = jax.random.split(jax.random.key(3), 3)
-    q = jax.random.normal(kq_, (b, 1, h, d), jnp.float32)
-    k = jax.random.normal(kk, (b, s, kh, d), jnp.float32)
-    v = jax.random.normal(kv, (b, s, kh, d), jnp.float32)
-    lengths = jnp.asarray([s - 5, s - 1], jnp.int32)
-    k8, ks = _kv_quant(k)
-    v8, vs = _kv_quant(v)
-    got = decode_attention(q, k8, v8, lengths, k_scale=ks, v_scale=vs,
-                           interpret=True)
-    want = causal_attention(
-        q, (k8.astype(jnp.float32) * ks), (v8.astype(jnp.float32) * vs),
-        q_positions=(lengths - 1)[:, None], kv_length=lengths)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               atol=2e-5)
