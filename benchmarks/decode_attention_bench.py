@@ -25,6 +25,7 @@ Run:  python benchmarks/decode_attention_bench.py
 from __future__ import annotations
 
 import os
+import sys
 import time
 
 import jax
@@ -32,7 +33,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from bench import diff_time_scan
+# allow `python benchmarks/decode_attention_bench.py` from anywhere —
+# bench.py lives at the repo root, one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from bench import diff_time_scan  # noqa: E402
 from cloud_server_tpu.inference.engine import _kv_quant
 from cloud_server_tpu.inference.paged_engine import quantize_pool
 from cloud_server_tpu.ops.attention import causal_attention

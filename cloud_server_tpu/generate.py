@@ -48,8 +48,8 @@ def build_parser() -> argparse.ArgumentParser:
                    "the weight bytes streamed per decode step)")
     p.add_argument("--kv-cache-int8", action="store_true",
                    help="store the KV cache int8-quantized (halves cache "
-                   "memory; pair with decode_attention_impl='pallas' for "
-                   "in-VMEM dequant)")
+                   "memory; the scales fold into the attention math, so "
+                   "there is no dequantized cache copy)")
     p.add_argument("--ema", action="store_true",
                    help="serve the EMA-averaged weights from a checkpoint "
                    "trained with ema_decay > 0 (reads the checkpoint's "

@@ -200,7 +200,14 @@ def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
     attention + per-document RoPE semantics as the dense family
     (transformer.forward_hidden)."""
     cos, sin = rope_table(cfg, tokens.shape[1])
-    x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
+    # Unshard the table's embed dim BEFORE the lookup: a tp-sharded D at
+    # the gather makes XLA produce a D-sharded (B, S, D) it must then
+    # replicate-and-repartition to the batch/sequence layout ("Involuntary
+    # full rematerialization" in the SPMD partitioner). One table
+    # all-gather per forward is strictly cheaper.
+    table = transformer.constrain(params["embed"]["tokens"].astype(cfg.dtype),
+                      ("vocab", None))
+    x = table[tokens]
     x = transformer.constrain(x, ("batch", "sequence", None))
     positions = None
     if segment_ids is not None:

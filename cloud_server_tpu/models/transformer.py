@@ -201,43 +201,13 @@ def apply_remat(block, cfg: ModelConfig):
     raise ValueError(f"unknown remat policy: {cfg.remat!r}")
 
 
-def _get_attention_fn(cfg: ModelConfig):
+def _get_attention_fn(cfg: ModelConfig, segment_ids=None):
+    """The one attention-impl dispatch table, with or without a packed
+    segment mask (both callers — plain and packed forward — use this, so
+    segment support for a new impl lands everywhere at once)."""
     if cfg.attention_impl == "xla":
-        return causal_attention
-    if cfg.attention_impl == "flash":
-        from cloud_server_tpu.ops.flash_attention import flash_attention
-        return partial(flash_attention, block_q=cfg.flash_block_q,
-                       block_kv=cfg.flash_block_kv)
-    if cfg.attention_impl == "ring":
-        from cloud_server_tpu.parallel.mesh import current_mesh
-        from cloud_server_tpu.parallel.ring_attention import (
-            ring_attention_sharded)
-
-        mesh = current_mesh()
-
-        def ring_fn(q, k, v):
-            return ring_attention_sharded(q, k, v, mesh)
-
-        return ring_fn
-    if cfg.attention_impl == "ulysses":
-        from cloud_server_tpu.parallel.mesh import current_mesh
-        from cloud_server_tpu.parallel.ulysses import (
-            ulysses_attention_sharded)
-
-        mesh = current_mesh()
-
-        def ulysses_fn(q, k, v):
-            return ulysses_attention_sharded(q, k, v, mesh)
-
-        return ulysses_fn
-    raise ValueError(f"unknown attention_impl: {cfg.attention_impl!r}")
-
-
-def _packed_attention_fn(cfg: ModelConfig, segment_ids):
-    """attn_fn for a packed batch — the single dispatch point both model
-    families (dense here, MoE in models/moe.py) use, so segment support
-    for a new attention impl lands everywhere at once."""
-    if cfg.attention_impl == "xla":
+        if segment_ids is None:
+            return causal_attention
         return partial(causal_attention, segment_ids=segment_ids)
     if cfg.attention_impl == "flash":
         from cloud_server_tpu.ops.flash_attention import flash_attention
@@ -271,6 +241,11 @@ def _packed_attention_fn(cfg: ModelConfig, segment_ids):
     raise ValueError(f"unknown attention_impl: {cfg.attention_impl!r}")
 
 
+def _packed_attention_fn(cfg: ModelConfig, segment_ids):
+    """Back-compat alias: the packed variant of the dispatch table."""
+    return _get_attention_fn(cfg, segment_ids)
+
+
 def apply_segment_loss_mask(batch: dict) -> dict:
     """If the batch is packed, fold the segment boundary/padding mask into
     batch['mask'] (shared by the dense and MoE losses). No-op otherwise."""
@@ -295,7 +270,14 @@ def forward_hidden(params: Params, tokens: jnp.ndarray,
     alone.
     """
     cos, sin = rope_table(cfg, tokens.shape[1])
-    x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
+    # Unshard the table's embed dim BEFORE the lookup: a tp-sharded D at
+    # the gather makes XLA produce a D-sharded (B, S, D) it must then
+    # replicate-and-repartition to the batch/sequence layout ("Involuntary
+    # full rematerialization" in the SPMD partitioner). One table
+    # all-gather per forward is strictly cheaper.
+    table = constrain(params["embed"]["tokens"].astype(cfg.dtype),
+                      ("vocab", None))
+    x = table[tokens]
     # Anchor the residual stream to (batch, sequence, -) so that with
     # sp > 1 every per-position op (norms, MLP, fused CE) computes S/sp per
     # device; only ring attention's shard_map sees the full sequence.

@@ -141,29 +141,9 @@ def test_packed_moe_loss_matches_standalone():
     assert float(packed_loss) == pytest.approx(want, rel=1e-4)
 
 
-def test_packed_rejects_pipelined_loss(devices8):
-    from cloud_server_tpu.config import MeshConfig
-    from cloud_server_tpu.parallel.mesh import make_mesh
-    from cloud_server_tpu.parallel.pipeline import make_pipelined_loss
-
-    mesh = make_mesh(MeshConfig(pp=2, fsdp=4))
-    loss_fn = make_pipelined_loss(TINY, mesh, num_microbatches=2)
-    params = transformer.init_params(TINY, jax.random.key(0))
-    toks, segs = pack_documents([[1, 2, 3, 4]], 8)
-    with pytest.raises(ValueError, match="segment_ids"):
-        loss_fn(params, {"tokens": jnp.asarray(np.repeat(toks, 8, 0)),
-                         "segment_ids": jnp.asarray(np.repeat(segs, 8, 0))},
-                TINY)
-
-
-def test_packed_rejects_sequence_parallel_attention():
-    import dataclasses
-    cfg = dataclasses.replace(TINY, attention_impl="ring")
-    params = transformer.init_params(cfg, jax.random.key(0))
-    toks, segs = pack_documents([[1, 2, 3]], 8)
-    with pytest.raises(ValueError, match="xla"):
-        transformer.forward(params, jnp.asarray(toks), cfg,
-                            jnp.asarray(segs))
+# The old packed-rejection guards (pipelined loss, ring/ulysses
+# attention) are gone: those combinations now WORK and are
+# parity-tested in tests/test_packed_parallel.py.
 
 
 def _rand_qkv(key, b, s, h, kh, d):
