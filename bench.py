@@ -44,17 +44,26 @@ import time
 import jax
 import jax.numpy as jnp
 
-def _baseline_tokens_per_sec() -> float:
-    """Previous round's measured tokens/s (same config & chip), read from
-    BENCH_r01.json so a regenerated baseline can't silently diverge from a
-    hardcoded copy. Falls back to 1:1 if the file is missing."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_r01.json")
-    try:
-        with open(path) as f:
-            return float(json.load(f)["parsed"]["value"])
-    except (OSError, KeyError, ValueError, TypeError):
-        return 0.0
+def _baseline_tokens_per_sec() -> tuple[str, float]:
+    """(round_tag, tokens/s) of the latest BENCH_r*.json present — so
+    vs_baseline is a round-over-round ratio and a regression shows up as
+    < 1.0 at a glance (r3's ratio-to-r1 hid a 0.6% regression vs r2).
+    The tag rides in the output so a reader can tell WHICH round the
+    ratio divides by (if this round's own file has already been saved
+    when bench re-runs, the ratio is vs itself ~= 1.0 and the tag says
+    so). Falls back to 1:1 if no prior bench file exists."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                value = float(json.load(f)["parsed"]["value"])
+            tag = os.path.basename(path)[len("BENCH_"):-len(".json")]
+            return tag, value
+        except (OSError, KeyError, ValueError, TypeError):
+            continue
+    return "none", 0.0
 
 
 def sync_device(x) -> None:
@@ -410,7 +419,8 @@ def main() -> None:
     if os.environ.get("BENCH_SKIP_SERVING") != "1":
         extra.update({k: round(v, 1) for k, v in serving_bench().items()})
 
-    base = _baseline_tokens_per_sec()
+    base_tag, base = _baseline_tokens_per_sec()
+    extra["baseline_round"] = base_tag
     print(json.dumps({
         "metric": "train_tokens_per_sec_330M_bf16",
         "value": round(train["tokens_per_sec"], 1),

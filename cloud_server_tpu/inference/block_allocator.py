@@ -8,8 +8,12 @@ mid-decode and no preemption path is needed.
 
 Sharing model (radix-style, page granularity): a FULL page of kv is
 identified by the token chain that produced it — the cache key is
-(parent_page_id, page_tokens), so a chain of keys spells out the whole
-prefix. Walking a prompt page-by-page either extends a chain of hits
+(parent_chain_hash, page_tokens), where parent_chain_hash is a running
+hash over every preceding page's key (vLLM-style block hashing). Keys
+are pure CONTENT: they never reference physical page ids, so reusing an
+evicted page's id can never alias an old chain (the ABA hazard of
+id-based keys). Walking a prompt page-by-page either extends a chain of
+hits
 (each hit bumps a refcount and costs zero prefill FLOPs) or misses and
 switches to fresh private pages. On release, a request's full private
 pages are KEYED into the cache (refcount 0, LRU-ordered) rather than
@@ -33,15 +37,35 @@ FULL pages strictly before every sharing slot's first private position,
 and the engine only writes at positions >= lengths >= that boundary. An
 evicted page has refcount 0 — no slot's table points at it.
 
-Eviction orphans: evicting a parent page makes cached children
-unreachable (their key embeds the parent's page id); they age out via
-LRU. Correctness is unaffected — lookups simply miss.
+Eviction orphans: evicting a parent page leaves cached children
+unreachable for now (lookup walks front-to-back and stops at the first
+miss — attention needs contiguous prefix KV). They age out via LRU, or
+become reachable again if another request re-materializes the same
+parent content (keys are content-only, so the chain re-links).
+Correctness is unaffected either way — a miss is just a miss.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import hashlib
+
+# Root digest for every chain. Chain hashing uses blake2b-128 over
+# (parent_digest, page_tokens) rather than Python's builtin hash():
+# the builtin's int-tuple hash is 64-bit, non-cryptographic, and
+# deterministic across processes — an attacker who can choose token ids
+# could construct two prompt chains whose keys collide and read another
+# request's cached KV (the exact design vLLM patched in
+# CVE-2025-25183). The token tuple itself also rides in the key, so a
+# wrong hit additionally requires identical page content.
+_ROOT = b"\x00" * 16
+
+
+def _chain_digest(parent: bytes, page_tokens: tuple[int, ...]) -> bytes:
+    h = hashlib.blake2b(parent, digest_size=16)
+    h.update(",".join(map(str, page_tokens)).encode())
+    return h.digest()
 
 
 @dataclasses.dataclass
@@ -69,8 +93,8 @@ class BlockAllocator:
         # holds ONLY refcount-0 keyed pages, in insertion order — python
         # dicts iterate oldest-first, giving an O(1) LRU (pages re-insert
         # on every release, so insertion order IS recency order)
-        self._cache: dict[tuple[int, tuple[int, ...]], int] = {}
-        self._key_of: dict[int, tuple[int, tuple[int, ...]]] = {}
+        self._cache: dict[tuple[bytes, tuple[int, ...]], int] = {}
+        self._key_of: dict[int, tuple[bytes, tuple[int, ...]]] = {}
         self._evictable: dict[int, None] = {}
         self.prefix_hit_pages = 0
         self.prefix_miss_pages = 0
@@ -124,7 +148,7 @@ class BlockAllocator:
         """
         ps = self.page_size
         shared: list[int] = []
-        parent = -1
+        parent = _ROOT
         limit = (len(prompt) - 1) // ps  # full pages, leaving >= 1 token
         for i in range(limit):
             key = (parent, tuple(prompt[i * ps:(i + 1) * ps]))
@@ -136,7 +160,7 @@ class BlockAllocator:
             self._ref[page] += 1
             self._evictable.pop(page, None)  # active again
             shared.append(page)
-            parent = page
+            parent = _chain_digest(*key)
         return shared, len(shared) * ps
 
     # -- release ------------------------------------------------------------
@@ -147,38 +171,23 @@ class BlockAllocator:
         slot's committed prompt + generated ids) or return to the free
         list (the partial tail)."""
         ps = self.page_size
-        parent = -1
+        parent = _ROOT
         for i, page in enumerate(pages):
             self._ref[page] -= 1
             full = (i + 1) * ps <= len(tokens)
-            key = None
             if full:
                 key = (parent, tuple(tokens[i * ps:(i + 1) * ps]))
-                if page not in self._key_of:
-                    existing = self._cache.get(key)
-                    if existing is None:
-                        self._cache[key] = page
-                        self._key_of[page] = key
-                    # else: duplicate content under another page — leave
-                    # this page unkeyed; it frees below when unreferenced
+                if page not in self._key_of and key not in self._cache:
+                    # (a duplicate-content page under another id stays
+                    # unkeyed; it frees below when unreferenced)
+                    self._cache[key] = page
+                    self._key_of[page] = key
+                # content digest: the chain continues regardless of which
+                # physical page is canonical for this position
+                parent = _chain_digest(*key)
             if self._ref[page] <= 0:
                 self._ref[page] = 0
-                if self._key_of.get(page) is not None:
+                if page in self._key_of:
                     self._evictable[page] = None
                 else:
                     self._free.append(page)
-            # the canonical page for this chain position (for children's
-            # keys): whatever the cache maps the key to now
-            parent = self._cache.get(key, -1) if key is not None else -1
-            if parent == -1:
-                # chain broken (uncacheable page) — descendants can't be
-                # keyed either; stop keying but keep dropping refs
-                for later in pages[i + 1:]:
-                    self._ref[later] -= 1
-                    if self._ref[later] <= 0:
-                        self._ref[later] = 0
-                        if self._key_of.get(later) is not None:
-                            self._evictable[later] = None
-                        else:
-                            self._free.append(later)
-                return

@@ -656,6 +656,15 @@ class PagedInferenceServer:
             pending, self._pending = list(self._pending), collections.deque()
         for sid, slot in enumerate(self._slots):
             if slot is not None:
+                # release with tokens=[] — drops the refs (keeping the
+                # allocator consistent for any future recovery path) but
+                # keys NOTHING: a failed dispatch may have left these
+                # pages half-written, so they must not enter the prefix
+                # cache as valid KV
+                self.allocator.release(slot.pages, [])
+                self.tables[sid, :] = self.allocator.num_pages
+                self.active[sid] = False
+                self.lengths[sid] = 0
                 slot.req.finish_reason = f"error: {exc!r}"
                 slot.req._done.set()
                 self._slots[sid] = None

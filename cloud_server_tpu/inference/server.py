@@ -308,6 +308,16 @@ class InferenceServer:
 
         self.params = jax.tree.map(
             cast_leaf, params, is_leaf=lambda x: isinstance(x, QTensor))
+        if cfg.decode_attention_impl != "xla":
+            # fail at construction, not deep inside the first jitted
+            # decode trace (engine.decode_step raises the detailed error;
+            # PagedInferenceServer validates eagerly the same way)
+            raise ValueError(
+                f"decode_attention_impl={cfg.decode_attention_impl!r} is "
+                "not supported by the contiguous InferenceServer — the "
+                "pallas decode kernel lives in the paged serving stack "
+                "(inference.paged_server.PagedInferenceServer); use "
+                "'xla' here")
         self.cfg = cfg
         self.infer_cfg = infer_cfg
         self.max_slots = max_slots

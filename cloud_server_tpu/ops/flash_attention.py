@@ -203,37 +203,27 @@ def _vmem(shape, dtype):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
-                     scale, block_q, block_kv, has_seg, stage_dq):
-    """kv-stationary backward producing dk, dv and (stage_dq) per-kv-block
-    dq partials in ONE pass. s/p are recomputed once per (j, i) block
-    pair — the two-pass layout runs a second q-stationary kernel for dq,
-    paying the whole recompute twice. dq partials land in a (nkv, ...)
-    staging array (each (j, i) cell owns one block; summed over nkv by
-    XLA afterwards), costing nkv x q-bytes of f32 HBM to remove a full
-    blockwise recompute pass — the dominant bwd cost at the bench shapes.
-    For long sequences (nkv > _DQ_STAGE_MAX_NKV) that staging memory
-    grows quadratically in S, so stage_dq=False restores the two-pass
-    path."""
+                     scale, block_q, block_kv, has_seg):
+    """kv-stationary backward producing dk, dv (q innermost so they
+    accumulate in scratch); dq runs as a second q-stationary pass.
+
+    Negative result (v5e, r3): a single-pass variant that staged
+    per-kv-block dq partials in a (nkv, ...) f32 HBM array — trading the
+    second recompute pass for nkv x dq-bytes of traffic — measured SLOWER
+    at every shape tried (S=2048/1024-blocks: 67.2 vs 65.2 ms;
+    S=1024/512-blocks: 242 vs 236) because the backward is
+    bandwidth-bound, not compute-bound. The staged path was deleted in r4;
+    this two-pass layout is the keeper."""
     if has_seg:
-        sq_ref, skv_ref, dk_ref, dv_ref, *rest = refs
+        sq_ref, skv_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
     else:
-        dk_ref, dv_ref, *rest = refs
-    if stage_dq:
-        dqp_ref, dk_acc, dv_acc = rest
-    else:
-        dqp_ref = None
-        dk_acc, dv_acc = rest
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
     j, i = pl.program_id(2), pl.program_id(3)  # kv-stationary: q innermost
 
     @pl.when(i == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
-
-    if stage_dq:
-        # every (j, i) cell owns its dq-partial block — cells skipped by
-        # the causal guard must still zero it
-        dqp_ref[0, 0, 0] = jnp.zeros_like(dqp_ref[0, 0, 0])
 
     @pl.when(i * block_q + block_q - 1 >= j * block_kv)
     def _compute():
@@ -261,8 +251,6 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
         dp = _dot(do, v, ((1,), (1,)))
         ds = p * (dp - delta) * scale
         dk_acc[:] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))
-        if stage_dq:
-            dqp_ref[0, 0, 0] = _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
     @pl.when(i == pl.num_programs(3) - 1)
     def _finalize():
@@ -306,11 +294,9 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
                    scale, block_q, block_kv, has_seg):
-    """q-stationary dq pass — the LONG-SEQUENCE fallback. The single-pass
-    kernel above stages dq partials in a (nkv, ...) f32 array whose nkv
-    factor grows linearly with S (quadratic total HBM); past
-    _DQ_STAGE_MAX_NKV the old two-pass layout (second recompute, O(S)
-    memory) is the right trade."""
+    """q-stationary dq pass (kv innermost, dq accumulates in scratch).
+    Recomputes s/p a second time — measured cheaper than staging dq
+    partials through HBM on v5e (see _bwd_dkdv_kernel's docstring)."""
     if has_seg:
         sq_ref, skv_ref, dq_ref, dq_acc = refs
     else:
@@ -350,17 +336,6 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *refs,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-# Largest kv-block count for which the single-pass backward may stage dq
-# partials ((b, h, nkv, sq, d) f32 — nkv x dq-bytes of HBM). Measured on
-# v5e (r3): the staged path LOSES to the two-pass recompute at every
-# shape tried (S=2048/1024-blocks: 67.2 vs 65.2 ms; S=1024/512-blocks:
-# 236 vs 242 — both behind the 221 ms fused single-block path) because
-# the backward is bandwidth-bound and staging trades MXU recompute for
-# HBM round trips. Kept at 0 (two-pass default); the staged path remains
-# selectable here for hardware where compute, not bandwidth, binds.
-_DQ_STAGE_MAX_NKV = 0
-
-
 # ---------------------------------------------------------------------------
 # custom_vjp wrapper
 # ---------------------------------------------------------------------------
@@ -392,12 +367,9 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
     nq, nkv = pl.cdiv(sq, block_q), pl.cdiv(skv, block_kv)
     has_seg = segment_ids is not None
     seg_inputs = list(_seg_views(segment_ids)) if has_seg else []
-    stage_dq = nkv <= _DQ_STAGE_MAX_NKV
 
-    # ONE kv-stationary pass produces dk, dv and (for bounded nkv)
-    # per-kv-block dq partials (q innermost so dk/dv accumulate in
-    # scratch). Outputs are per *q-head*; dk/dv sum over the GQA group
-    # afterwards, dq sums over its nkv staging axis.
+    # Pass 1 (kv-stationary, q innermost): dk, dv accumulate in scratch.
+    # Outputs are per *q-head*; dk/dv sum over the GQA group afterwards.
     q_spec_ks = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, j, i: (bi, hi, i, 0))
     kv_spec_ks = pl.BlockSpec((1, 1, block_kv, d),
                               lambda bi, hi, j, i: (bi, hi // g, j, 0))
@@ -405,58 +377,45 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
                                lambda bi, hi, j, i: (bi, hi, i, 0))
     dkv_out_spec = pl.BlockSpec((1, 1, block_kv, d),
                                 lambda bi, hi, j, i: (bi, hi, j, 0))
-    dqp_out_spec = pl.BlockSpec((1, 1, 1, block_q, d),
-                                lambda bi, hi, j, i: (bi, hi, j, i, 0))
 
     dkdv_in_specs = [q_spec_ks, kv_spec_ks, kv_spec_ks, q_spec_ks,
                      lse_spec_ks, q_spec_ks]
     if has_seg:
         dkdv_in_specs.extend(_seg_specs(block_q, block_kv, qs_order=False))
-    out_specs = [dkv_out_spec, dkv_out_spec]
-    out_shapes = [jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32),
-                  jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32)]
-    if stage_dq:
-        out_specs.append(dqp_out_spec)
-        out_shapes.append(
-            jax.ShapeDtypeStruct((b, h, nkv, sq, d), jnp.float32))
-    res = pl.pallas_call(
+    dk_h, dv_h = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, block_q=block_q,
-                          block_kv=block_kv, has_seg=has_seg,
-                          stage_dq=stage_dq),
+                          block_kv=block_kv, has_seg=has_seg),
         grid=(b, h, nkv, nq),
         in_specs=dkdv_in_specs,
-        out_specs=out_specs,
-        out_shape=out_shapes,
+        out_specs=[dkv_out_spec, dkv_out_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b, h, skv, d), jnp.float32)],
         scratch_shapes=[_vmem((block_kv, d), jnp.float32),
                         _vmem((block_kv, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, out, lse, do, *seg_inputs)
 
-    if stage_dq:
-        dk_h, dv_h, dq_p = res
-        dq = dq_p.sum(axis=2).astype(q.dtype)
-    else:
-        dk_h, dv_h = res
-        q_spec_qs = pl.BlockSpec((1, 1, block_q, d),
-                                 lambda bi, hi, i, j: (bi, hi, i, 0))
-        kv_spec_qs = pl.BlockSpec((1, 1, block_kv, d),
-                                  lambda bi, hi, i, j: (bi, hi // g, j, 0))
-        lse_spec_qs = pl.BlockSpec((1, 1, block_q, LSE_LANES),
-                                   lambda bi, hi, i, j: (bi, hi, i, 0))
-        dq_in_specs = [q_spec_qs, kv_spec_qs, kv_spec_qs, q_spec_qs,
-                       lse_spec_qs, q_spec_qs]
-        if has_seg:
-            dq_in_specs.extend(_seg_specs(block_q, block_kv))
-        dq = pl.pallas_call(
-            functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
-                              block_kv=block_kv, has_seg=has_seg),
-            grid=(b, h, nq, nkv),
-            in_specs=dq_in_specs,
-            out_specs=q_spec_qs,
-            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-            scratch_shapes=[_vmem((block_q, d), jnp.float32)],
-            interpret=interpret,
-        )(q, k, v, out, lse, do, *seg_inputs)
+    # Pass 2 (q-stationary, kv innermost): dq accumulates in scratch.
+    q_spec_qs = pl.BlockSpec((1, 1, block_q, d),
+                             lambda bi, hi, i, j: (bi, hi, i, 0))
+    kv_spec_qs = pl.BlockSpec((1, 1, block_kv, d),
+                              lambda bi, hi, i, j: (bi, hi // g, j, 0))
+    lse_spec_qs = pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                               lambda bi, hi, i, j: (bi, hi, i, 0))
+    dq_in_specs = [q_spec_qs, kv_spec_qs, kv_spec_qs, q_spec_qs,
+                   lse_spec_qs, q_spec_qs]
+    if has_seg:
+        dq_in_specs.extend(_seg_specs(block_q, block_kv))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
+                          block_kv=block_kv, has_seg=has_seg),
+        grid=(b, h, nq, nkv),
+        in_specs=dq_in_specs,
+        out_specs=q_spec_qs,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[_vmem((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, out, lse, do, *seg_inputs)
     dk = dk_h.reshape(b, kh, g, skv, d).sum(axis=2).astype(k.dtype)
     dv = dv_h.reshape(b, kh, g, skv, d).sum(axis=2).astype(v.dtype)
     return dq, dk, dv, None

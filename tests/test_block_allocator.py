@@ -114,6 +114,40 @@ def test_duplicate_content_frees_extra_page():
     a.release(shared, [7, 8])
 
 
+def test_evicted_parent_id_reuse_cannot_alias_children():
+    """ABA regression: keys are content-chain hashes, not parent page
+    ids. Evict a chain's parent, let a different chain reuse its
+    physical id, then probe with a prompt whose tail matches the OLD
+    chain's children — the lookup must miss (the old children are
+    unreachable), never serve the stale pages."""
+    a = BlockAllocator(2, page_size=2)
+    p = a.alloc(2)
+    a.release(p, [1, 2, 3, 4])          # chain: page A=[1,2] -> B=[3,4]
+    # Force eviction of the LRU page (the parent A) only.
+    q = a.alloc(1)
+    assert q == [p[0]] and a.evictions == 1
+    a.release(q, [9, 9])                 # A's id now keys chain [9,9]
+    # Old-style (parent_id, tokens) keys would hit B here and serve KV
+    # for prefix [1,2] under a [9,9] prompt — silent corruption.
+    shared, n = a.lookup_prefix([9, 9, 3, 4, 5])
+    assert shared == [p[0]] and n == 2   # only the genuine [9,9] page
+    a.release(shared, [9, 9])
+
+
+def test_rematerialized_parent_relinks_orphaned_children():
+    """Content keys mean an orphaned child becomes reachable again once
+    another request re-creates the same parent content."""
+    a = BlockAllocator(2, page_size=2)
+    p = a.alloc(2)
+    a.release(p, [1, 2, 3, 4])
+    q = a.alloc(1)                       # evicts parent [1,2]
+    assert a.evictions == 1
+    a.release(q, [1, 2])                 # re-materializes the parent
+    shared, n = a.lookup_prefix([1, 2, 3, 4, 5])
+    assert n == 4 and shared == [q[0], p[1]]
+    a.release(shared, [1, 2, 3, 4])
+
+
 def test_release_with_no_committed_tokens_frees_everything():
     a = BlockAllocator(4, page_size=4)
     pages = a.alloc(4)
