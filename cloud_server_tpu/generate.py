@@ -1,14 +1,18 @@
 """Text generation CLI: `python -m cloud_server_tpu.generate`.
 
 Loads model params from a training checkpoint (or random-inits for smoke
-runs), tokenizes prompts, and serves them through the continuous-batching
-`InferenceServer`. The tokenizer is byte-level by default or a local
-HuggingFace `tokenizer.json` via `--tokenizer`.
+runs), tokenizes prompts, and serves them through the paged
+continuous-batching server (`PagedInferenceServer` — block-table KV,
+radix prefix reuse, chunked prefill, optional in-server speculative
+decoding via `--spec-drafts`). `--contiguous` selects the legacy
+fixed-slot `InferenceServer` instead. The tokenizer is byte-level by
+default or a local HuggingFace `tokenizer.json` via `--tokenizer`.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 
@@ -67,6 +71,34 @@ def build_parser() -> argparse.ArgumentParser:
                    help="decode steps per scheduler iteration (multi-token "
                    "scheduling; >1 amortises host sync at the cost of "
                    "admission latency)")
+    p.add_argument("--contiguous", action="store_true",
+                   help="serve through the legacy fixed-slot contiguous "
+                   "server instead of the paged server (no paging, no "
+                   "radix prefix reuse, no chunked prefill, no in-server "
+                   "speculation; supports --prefix single-prefix caching)")
+    p.add_argument("--max-slots", type=int, default=8,
+                   help="concurrent request slots in the server")
+    p.add_argument("--spec-drafts", type=int, default=0,
+                   help="paged server only: in-server speculative decoding "
+                   "with N n-gram draft tokens per round (exact accept "
+                   "rule — output distribution unchanged; wins on "
+                   "repetition-heavy output)")
+    p.add_argument("--page-size", type=int, default=128,
+                   help="paged server: tokens per KV page (multiple of 128 "
+                   "for the pallas decode kernel on TPU)")
+    p.add_argument("--num-pages", type=int, default=0,
+                   help="paged server: page pool size (0 = the HBM the "
+                   "contiguous layout would reserve: "
+                   "max_slots * max_context / page_size)")
+    p.add_argument("--prefill-chunk", type=int, default=256,
+                   help="paged server: admission window width — long "
+                   "prompts prefill in chunks this wide, interleaved with "
+                   "decode dispatches so inter-token latency stays bounded")
+    p.add_argument("--decode-impl", choices=["xla", "pallas"], default=None,
+                   help="decode-attention implementation override; "
+                   "'pallas' selects the paged-attention kernel "
+                   "(paged server on TPU — length-bounded page reads beat "
+                   "the XLA gather on ragged contexts)")
     p.add_argument("--draft-config", metavar="JSON",
                    help="speculative decoding: JSON config (model section) "
                    "of a small draft model sharing the tokenizer; batch "
@@ -140,8 +172,23 @@ def main(argv=None) -> None:
     else:
         model_cfg = from_json(ModelConfig, raw.get("model", {}))
     if args.kv_cache_int8:
-        import dataclasses
         model_cfg = dataclasses.replace(model_cfg, kv_cache_dtype="int8")
+    if args.decode_impl is not None:
+        if args.contiguous and args.decode_impl != "xla":
+            raise SystemExit(
+                "--decode-impl pallas needs the paged server; drop "
+                "--contiguous")
+        model_cfg = dataclasses.replace(
+            model_cfg, decode_attention_impl=args.decode_impl)
+    if args.spec_drafts and args.contiguous:
+        raise SystemExit(
+            "--spec-drafts is the paged server's in-server speculation; "
+            "it cannot run with --contiguous (use --ngram-draft/"
+            "--draft-config for the batch API instead)")
+    if args.spec_drafts and (args.draft_config or args.ngram_draft):
+        raise SystemExit(
+            "--spec-drafts (in-server) and --draft-config/--ngram-draft "
+            "(batch API) are mutually exclusive speculation paths")
     tok = get_tokenizer(args.tokenizer)
     if tok.vocab_size > model_cfg.vocab_size:
         raise SystemExit(
@@ -216,18 +263,48 @@ def main(argv=None) -> None:
         eos_token_id=tok.eos_id if tok.eos_id is not None else -1,
         pad_token_id=tok.pad_id or 0)
 
+    def make_server(max_len: int, max_slots: int):
+        """Build the serving backend: paged by default, contiguous on
+        --contiguous. Same client API either way (submit / generate /
+        start / stop)."""
+        if args.contiguous:
+            prefix_toks = (tok.encode(args.prefix,
+                                      add_bos=args.add_bos
+                                      and tok.bos_id is not None)
+                           if args.prefix else None)
+            return InferenceServer(
+                params, model_cfg, infer_cfg, max_slots=max_slots,
+                max_len=max_len, seed=args.seed,
+                decode_chunk=args.decode_chunk,
+                prefix_tokens=prefix_toks)
+        if args.prefix:
+            print("[generate] note: the paged server reuses shared "
+                  "prefixes automatically (radix page cache); --prefix "
+                  "needs no pre-registration — prompts that start with "
+                  "the prefix text hit the cache after the first request",
+                  file=sys.stderr)
+        ps = args.page_size
+        max_context = -(-max_len // ps) * ps  # round up to a page multiple
+        prefill_chunk = -(-max(ps, args.prefill_chunk) // ps) * ps
+        from cloud_server_tpu.inference.paged_server import (
+            PagedInferenceServer)
+        return PagedInferenceServer(
+            params, model_cfg, infer_cfg, max_slots=max_slots,
+            max_context=max_context, page_size=ps,
+            num_pages=args.num_pages or None,
+            decode_chunk=args.decode_chunk,
+            spec_drafts=args.spec_drafts,
+            prefill_chunk=prefill_chunk, seed=args.seed)
+
     if args.serve_http is not None:
         if args.draft_config or args.ngram_draft:
             raise SystemExit(
                 "--draft-config/--ngram-draft are batch-mode only; "
-                "--serve-http would silently serve without speculation")
+                "--serve-http would silently serve without speculation "
+                "(the serving-path flag is --spec-drafts)")
         from cloud_server_tpu.inference.http_server import HttpFrontend
         max_len = args.max_len or model_cfg.max_seq_len
-        prefix_toks = tok.encode(args.prefix) if args.prefix else None
-        srv = InferenceServer(params, model_cfg, infer_cfg, max_slots=8,
-                              max_len=max_len, seed=args.seed,
-                              decode_chunk=args.decode_chunk,
-                              prefix_tokens=prefix_toks).start()
+        srv = make_server(max_len, args.max_slots).start()
         front = HttpFrontend(srv, tokenizer=tok, port=args.serve_http)
         front.start()
         host, port = front.address
@@ -288,7 +365,6 @@ def main(argv=None) -> None:
         if max_new < args.max_new:
             print(f"[generate] clamping --max-new {args.max_new} -> "
                   f"{max_new} to fit max_len={cap}", file=sys.stderr)
-            import dataclasses
             infer_cfg = dataclasses.replace(infer_cfg,
                                             max_decode_len=max_new)
         padded = np.zeros((len(encoded), longest), np.int32)
@@ -316,15 +392,10 @@ def main(argv=None) -> None:
 
     longest = max(len(e) for e in encoded)
     max_len = args.max_len or min(model_cfg.max_seq_len,
-                                  longest + args.max_new)
-    prefix_toks = (tok.encode(args.prefix,
-                              add_bos=args.add_bos
-                              and tok.bos_id is not None)
-                   if args.prefix else None)
-    srv = InferenceServer(params, model_cfg, infer_cfg,
-                          max_slots=min(8, len(encoded)), max_len=max_len,
-                          seed=args.seed, decode_chunk=args.decode_chunk,
-                          prefix_tokens=prefix_toks)
+                                  longest + args.max_new +
+                                  (0 if args.contiguous
+                                   else args.spec_drafts + 1))
+    srv = make_server(max_len, min(args.max_slots, len(encoded)))
     outs = srv.generate(encoded, max_new_tokens=args.max_new)
     for prompt, out in zip(prompts, outs):
         print(f"=== {prompt!r}")

@@ -1,9 +1,13 @@
-"""HTTP front-end for the continuous-batching server.
+"""HTTP front-end for the continuous-batching servers.
 
-A thin stdlib (`http.server`) layer over `InferenceServer.submit`: prompts
-go in as JSON, tokens stream back as newline-delimited JSON the moment the
-scheduler emits them. No framework dependency — the serving hot path stays
-the jitted TPU program; this module only does sockets and JSON.
+A thin stdlib (`http.server`) layer over the server `submit` API —
+`PagedInferenceServer` (the recommended backend: paged KV, radix prefix
+reuse, chunked prefill, in-server speculative decoding) or the legacy
+contiguous `InferenceServer`; both expose the same submit / num_active /
+num_pending surface. Prompts go in as JSON, tokens stream back as
+newline-delimited JSON the moment the scheduler emits them. No framework
+dependency — the serving hot path stays the jitted TPU program; this
+module only does sockets and JSON.
 
 Protocol:
   POST /generate    {"prompt": "text"} or {"tokens": [1, 2, 3]},
@@ -34,19 +38,18 @@ import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from cloud_server_tpu.inference.server import InferenceServer
-
 _STREAM_END = object()
 
 
 class HttpFrontend:
-    """Bind an InferenceServer (+ optional tokenizer) to an HTTP port.
+    """Bind a serving backend (+ optional tokenizer) to an HTTP port.
 
-    The InferenceServer's scheduler must be running (srv.start()) or be
-    driven externally; this class never steps it.
+    `srv` is a `PagedInferenceServer` or `InferenceServer` (any object
+    with submit/num_active/num_pending). Its scheduler must be running
+    (srv.start()) or be driven externally; this class never steps it.
     """
 
-    def __init__(self, srv: InferenceServer, tokenizer=None,
+    def __init__(self, srv, tokenizer=None,
                  host: str = "127.0.0.1", port: int = 0):
         self.srv = srv
         self.tokenizer = tokenizer

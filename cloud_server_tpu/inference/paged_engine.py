@@ -46,7 +46,7 @@ from cloud_server_tpu.inference.engine import _kv_quant, _mlp_apply
 from cloud_server_tpu.models import transformer
 from cloud_server_tpu.ops import rms_norm, rope_table
 from cloud_server_tpu.ops.paged_attention import (
-    paged_attention, paged_attention_xla)
+    paged_attention, paged_attention_tp, paged_attention_xla)
 
 
 class PagedKVCache(NamedTuple):
@@ -184,20 +184,21 @@ def _write_window(cache: PagedKVCache, layer: int, k, v, pos):
                           k_scale=new["k_scale"], v_scale=new["v_scale"])
 
 
-# Widest window the pallas kernel serves: its whole-batch q/o VMEM blocks
-# scale with B*W (B=8, W=64 already ~2 MB each next to the 16 MB scoped
-# limit). Wider windows (prefill chunks) take the XLA gather path — at
-# W >= page_size the dense W x S matmuls have real arithmetic intensity
-# and the per-layer gather amortises over the window, which is exactly
-# where XLA is strong; the kernel exists for the thin decode/verify
-# windows where gathers would dominate.
-_PALLAS_MAX_W = 32
+# Widest window the pallas path serves. Thin windows (<= 32) take the
+# batch-unrolled kernel with its cross-slot DMA chain; wider windows
+# (prefill chunks) dispatch the grid-over-(slot, head) wide kernel
+# (ops.paged_attention._paged_attention_wide) — length-bounded page
+# reads instead of the XLA path's full-padded-cache gather per layer
+# per chunk. Beyond this cap (wider than any prefill chunk the server
+# issues) the XLA gather path remains the fallback.
+_PALLAS_MAX_W = 256
 
 
 def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
                    cache: PagedKVCache, *, logits_at: jnp.ndarray | None,
                    all_logits: bool = False,
-                   pages_per_block: int | None = None):
+                   pages_per_block: int | None = None,
+                   mesh=None, tp_axis: str = "tp"):
     """Forward W new positions per slot against the paged cache.
 
     Args:
@@ -209,6 +210,12 @@ def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
         needs one sampled position per chunk, never the (B, W, V) tensor.
       all_logits: return (B, W, V) f32 (speculative verification).
         With neither, returns None (interior prefill chunks).
+      mesh, tp_axis: tensor-parallel serving. The XLA parts (matmuls,
+        gathers, unembed) need nothing — params carry NamedShardings and
+        jit propagates them, as in the contiguous engine. Only the
+        pallas kernel cannot be auto-partitioned; with a mesh whose
+        `tp_axis` is > 1 it runs under shard_map with kv heads sharded
+        (ops.paged_attention.paged_attention_tp).
 
     Returns (logits, cache') — cache' has the window written but lengths
     UNCHANGED (see module docstring).
@@ -231,10 +238,17 @@ def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
         q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin, pos)
         cache = _write_window(cache, layer_idx, k, v, pos)
         if use_pallas:
-            o = paged_attention(
-                q, cache.k, cache.v, lens_after, cache.tables, layer_idx,
-                pages_per_block=pages_per_block,
-                k_scale_pool=cache.k_scale, v_scale_pool=cache.v_scale)
+            if mesh is not None and mesh.shape.get(tp_axis, 1) > 1:
+                o = paged_attention_tp(
+                    q, cache.k, cache.v, lens_after, cache.tables,
+                    layer_idx, mesh=mesh, axis_name=tp_axis,
+                    pages_per_block=pages_per_block,
+                    k_scale_pool=cache.k_scale, v_scale_pool=cache.v_scale)
+            else:
+                o = paged_attention(
+                    q, cache.k, cache.v, lens_after, cache.tables,
+                    layer_idx, pages_per_block=pages_per_block,
+                    k_scale_pool=cache.k_scale, v_scale_pool=cache.v_scale)
         else:
             o = paged_attention_xla(
                 q, cache.k, cache.v, lens_after, cache.tables, layer_idx,

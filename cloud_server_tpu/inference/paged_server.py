@@ -101,12 +101,13 @@ def _split_cache(cache):
     return pools
 
 
-@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "scatter_prompt"),
+@partial(jax.jit,
+         static_argnames=("cfg", "infer_cfg", "scatter_prompt", "mesh"),
          donate_argnums=(1,))
 def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
                    slot_ids, prompt_rows, prompt_lens, rng, *,
                    cfg: ModelConfig, infer_cfg: InferConfig,
-                   scatter_prompt: bool):
+                   scatter_prompt: bool, mesh=None):
     """One admission chunk for a (padded) G-row group.
 
     chunk: (G, Wc) tokens for positions [g_lens, g_lens + Wc) per row —
@@ -122,7 +123,7 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
     """
     cache = _make_cache(state["pools"], g_lens, g_tables)
     logits, cache = paged_engine.window_forward(
-        params, chunk, cfg, cache, logits_at=sample_at)
+        params, chunk, cfg, cache, logits_at=sample_at, mesh=mesh)
     toks = sample_logits(logits, rng, infer_cfg)
     lps = _token_logprobs(logits, toks)
     hist = state["hist"]
@@ -135,11 +136,12 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
     return {"pools": _split_cache(cache), "hist": hist}, toks, lps
 
 
-@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "n_rounds"),
+@partial(jax.jit,
+         static_argnames=("cfg", "infer_cfg", "n_rounds", "mesh"),
          donate_argnums=(1,))
 def _decode_rounds(params, state, lengths, tables, last_token, live,
                    rng, *, cfg: ModelConfig, infer_cfg: InferConfig,
-                   n_rounds: int):
+                   n_rounds: int, mesh=None):
     """n_rounds plain decode steps (W=1) in one dispatch (lax.scan).
 
     `live` slots advance one token per round; the rest are frozen (their
@@ -161,7 +163,7 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
         cache = _make_cache(pools, lengths, tables)
         logits, cache = paged_engine.window_forward(
             params, last[:, None], cfg, cache,
-            logits_at=jnp.zeros_like(lengths))
+            logits_at=jnp.zeros_like(lengths), mesh=mesh)
         tok = sample_logits(logits, rng_t, infer_cfg)
         lp = _token_logprobs(logits, tok)
         tok = jnp.where(live, tok, pad)
@@ -177,11 +179,12 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "infer_cfg", "n_rounds", "n_drafts"),
+         static_argnames=("cfg", "infer_cfg", "n_rounds", "n_drafts",
+                          "mesh"),
          donate_argnums=(1,))
 def _spec_rounds(params, state, lengths, tables, last_token, live,
                  stop_len, rng, *, cfg: ModelConfig, infer_cfg: InferConfig,
-                 n_rounds: int, n_drafts: int):
+                 n_rounds: int, n_drafts: int, mesh=None):
     """n_rounds speculative rounds in one dispatch.
 
     Each round drafts `n_drafts` tokens per slot from its device-resident
@@ -216,7 +219,8 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
 
         cache = _make_cache(pools, lengths, tables)
         vlogits, cache = paged_engine.window_forward(
-            params, window, cfg, cache, logits_at=None, all_logits=True)
+            params, window, cfg, cache, logits_at=None, all_logits=True,
+            mesh=mesh)
         p_probs = sampling_probs(vlogits, infer_cfg)  # (B, G+1, V)
         n_acc, x = _accept_point_mass(drafts, p_probs, rng_acc)
 
@@ -295,7 +299,8 @@ class PagedInferenceServer:
                  page_size: int = 128, num_pages: int | None = None,
                  prompt_buckets: Sequence[int] | None = None,
                  decode_chunk: int = 8, spec_drafts: int = 0,
-                 prefill_chunk: int = 256, seed: int = 0):
+                 prefill_chunk: int = 256, seed: int = 0,
+                 mesh=None, tp_axis: str = "tp"):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -342,6 +347,19 @@ class PagedInferenceServer:
         # stay a small fixed set, chunk COUNTS are host-side loops
         self._rem_buckets = _pow2_buckets(16, self.prefill_chunk)
 
+        # Tensor-parallel serving: the XLA side needs only the params'
+        # NamedShardings (jit propagates). The mesh is kept for two
+        # things — sharding the page pools on their kv-head axis so the
+        # layout is intentional rather than inferred, and running the
+        # pallas kernel under shard_map (it cannot be auto-partitioned).
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        tp = 1 if mesh is None else int(mesh.shape.get(tp_axis, 1))
+        if tp > 1 and cfg.num_kv_heads % tp:
+            raise ValueError(
+                f"tp={tp} must divide num_kv_heads={cfg.num_kv_heads} "
+                "for tensor-parallel paged serving")
+
         cache = paged_engine.init_paged_cache(
             cfg, num_pages=num_pages, page_size=page_size, batch=max_slots,
             max_pages_per_slot=self.max_pages_per_slot)
@@ -349,6 +367,21 @@ class PagedInferenceServer:
             "pools": _split_cache(cache),
             "hist": jnp.zeros((max_slots, max_context), jnp.int32),
         }
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ax = tp_axis if tp > 1 else None
+
+            def put(x, spec):
+                return jax.device_put(x, NamedSharding(mesh, spec))
+
+            self.state = {
+                "pools": {
+                    name: put(pool,
+                              P(None, None, ax, None, None)
+                              if pool.ndim == 5 else P(None, None, ax, None))
+                    for name, pool in self.state["pools"].items()},
+                "hist": put(self.state["hist"], P()),
+            }
         # host-authoritative scheduling state
         self.tables = np.full((max_slots, self.max_pages_per_slot),
                               num_pages, np.int32)
@@ -553,7 +586,7 @@ class PagedInferenceServer:
             jnp.asarray(sample_at, jnp.int32), jnp.asarray(slot_ids),
             jnp.asarray(prompt_rows), jnp.asarray(prompt_lens, jnp.int32),
             self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg,
-            scatter_prompt=(c == 0))
+            scatter_prompt=(c == 0), mesh=self.mesh)
         toks, lps = jax.device_get((toks, lps))
         toks, lps = np.asarray(toks)[:g], np.asarray(lps)[:g]
         job.toks = np.where(in_range, toks, job.toks)
@@ -605,13 +638,14 @@ class PagedInferenceServer:
                 self.params, self.state, *args,
                 jnp.asarray(self.stop_len), self._next_rng(),
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
-                n_drafts=self.spec_drafts)
+                n_drafts=self.spec_drafts, mesh=self.mesh)
             toks, lps, counts, lens, last = jax.device_get(
                 (toks, lps, counts, lens, last))
         else:
             self.state, lens, last, (toks, lps, counts) = _decode_rounds(
                 self.params, self.state, *args, self._next_rng(),
-                cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n)
+                cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
+                mesh=self.mesh)
             toks, lps, counts, lens, last = jax.device_get(
                 (toks, lps, counts, lens, last))
             toks, lps = toks[:, :, None], lps[:, :, None]

@@ -249,6 +249,13 @@ def _paged_attention_kernel(
 # Host wrapper
 # ---------------------------------------------------------------------------
 
+# The batch-unrolled narrow kernel keeps every (slot, head) query row in
+# one VMEM block and its code size scales with B x KH x npages; past
+# these bounds the grid-over-(slot, head) wide kernel takes over (same
+# math, per-cell blocks).
+_NARROW_MAX_W = 32
+_NARROW_MAX_B = 16
+
 
 def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
                     scale=None, pages_per_block: int = 4,
@@ -298,6 +305,14 @@ def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
     qg = q.reshape(b, w, kh, g, d).transpose(0, 2, 1, 3, 4).reshape(
         b, kh, w * g, d)
 
+    if w > _NARROW_MAX_W or b > _NARROW_MAX_B:
+        out = _paged_attention_wide(
+            qg, k_pool, v_pool, lengths, tables, layer, scale=scale,
+            npages=npages, interpret=interpret,
+            k_scale_pool=k_scale_pool, v_scale_pool=v_scale_pool, w=w, g=g)
+        return out.reshape(b, kh, w, g, d).transpose(0, 2, 1, 3, 4).reshape(
+            b, w, h, d)
+
     def _full(shape):
         return pl.BlockSpec(shape, lambda i, *_: (0,) * len(shape))
 
@@ -341,6 +356,245 @@ def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
     # (B, KH, WG, Dh) -> (B, W, H, Dh)
     return out.reshape(b, kh, w, g, d).transpose(0, 2, 1, 3, 4).reshape(
         b, w, h, d)
+
+
+def _paged_attention_wide(qg, k_pool, v_pool, lengths, tables, layer, *,
+                          scale, npages, interpret, k_scale_pool,
+                          v_scale_pool, w, g):
+    """Grid-over-(slot, kv head) dispatch for wide windows / big batches.
+    qg: (B, KH, WG, Dh) folded queries; returns the same layout."""
+    b, kh, wg, d = qg.shape
+    ps = k_pool.shape[-1]
+    int8_kv = k_scale_pool is not None
+
+    cell = pl.BlockSpec((1, 1, wg, d), lambda bi, hi, *_: (bi, hi, 0, 0))
+    in_specs = [
+        cell,
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    inputs = [qg, k_pool, v_pool]
+    if int8_kv:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        inputs += [k_scale_pool, v_scale_pool]
+
+    scratch = [
+        pltpu.VMEM((2, npages, d, ps), k_pool.dtype),
+        pltpu.VMEM((2, npages, d, ps), v_pool.dtype),
+    ]
+    if int8_kv:
+        scratch += [pltpu.VMEM((2, npages, 1, ps), jnp.float32),
+                    pltpu.VMEM((2, npages, 1, ps), jnp.float32)]
+    scratch += [pltpu.SemaphoreType.DMA((2, npages))]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, kh),
+        in_specs=in_specs,
+        out_specs=cell,
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(
+        _paged_attention_wide_kernel, scale=float(scale), w=w, g=g,
+        ps=ps, npages=npages, int8_kv=int8_kv)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kh, wg, d), qg.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), tables.astype(jnp.int32),
+      jnp.asarray(layer, jnp.int32).reshape(1), *inputs)
+
+
+def _paged_attention_wide_kernel(
+    # scalar prefetch
+    lens_ref,          # (B,) i32 — kv length per slot INCLUDING the window
+    tables_ref,        # (B, max_pages) i32
+    layer_ref,         # (1,) i32
+    # inputs
+    q_ref,             # (1, 1, WG, Dh) VMEM — this (slot, kv head)'s rows
+    k_pool_ref,        # (L, P, KH, Dh, ps) HBM (ANY)
+    v_pool_ref,        # (L, P, KH, Dh, ps) HBM (ANY)
+    *refs,             # [k_scale_pool, v_scale_pool,] o_ref, scratch...
+    scale: float,
+    w: int,
+    g: int,
+    ps: int,
+    npages: int,
+    int8_kv: bool,
+):
+    """Wide-window (prefill-chunk) variant: one grid cell per
+    (slot, kv head) instead of a whole-batch unroll.
+
+    Why a second kernel: the narrow kernel keeps all B x KH x W*G query
+    rows in one VMEM block and statically unrolls slots — ideal for thin
+    decode windows (W <= 32), where its cross-slot DMA chain hides every
+    page fetch, but its VMEM footprint and code size scale with B x KH
+    so wide chunks do not fit. Here each cell holds only its own
+    (W*G, Dh) rows and 2 x npages page slices; at W >= page_size the
+    matmuls have real arithmetic intensity, so the per-cell prologue
+    bubble is noise while the length-bounded page reads still beat the
+    XLA path's full-padded-cache gather per layer per chunk.
+    """
+    if int8_kv:
+        (ks_pool_ref, vs_pool_ref, o_ref,
+         kbuf, vbuf, ksbuf, vsbuf, sems) = refs
+    else:
+        o_ref, kbuf, vbuf, sems = refs
+        ks_pool_ref = vs_pool_ref = ksbuf = vsbuf = None
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    wg = q_ref.shape[2]
+    d = q_ref.shape[-1]
+    num_pages_total = k_pool_ref.shape[1]
+    layer = layer_ref[0]
+    blk = ps * npages
+    kv_len = lens_ref[b]
+    dot_dtype = (jnp.float32 if k_pool_ref.dtype == jnp.float32
+                 else jnp.bfloat16)
+    n_blocks = jnp.maximum(1, lax.div(kv_len + blk - 1, blk))
+
+    def _copies(buf_idx, page_ids):
+        """One block's async copies — PER-HEAD (Dh, ps) slices here (the
+        narrow kernel fetches whole pages; a cell only needs its head)."""
+        out = []
+        for i in range(npages):
+            page = page_ids[i]
+            sem = sems.at[buf_idx, i]
+            out.append(pltpu.make_async_copy(
+                k_pool_ref.at[layer, page, h], kbuf.at[buf_idx, i], sem))
+            out.append(pltpu.make_async_copy(
+                v_pool_ref.at[layer, page, h], vbuf.at[buf_idx, i], sem))
+            if int8_kv:
+                # pl.ds keeps the copy rank-2 ((1, ps), lane-aligned)
+                out.append(pltpu.make_async_copy(
+                    ks_pool_ref.at[layer, page, pl.ds(h, 1)],
+                    ksbuf.at[buf_idx, i], sem))
+                out.append(pltpu.make_async_copy(
+                    vs_pool_ref.at[layer, page, pl.ds(h, 1)],
+                    vsbuf.at[buf_idx, i], sem))
+        return out
+
+    def _block_pages(blk_idx):
+        return [
+            jnp.clip(
+                tables_ref[b, jnp.clip(blk_idx * npages + i, 0,
+                                       tables_ref.shape[1] - 1)],
+                0, num_pages_total - 1)
+            for i in range(npages)
+        ]
+
+    def start_fetch(blk_idx, buf_idx):
+        for c in _copies(buf_idx, _block_pages(blk_idx)):
+            c.start()
+
+    def wait_fetch(buf_idx):
+        for c in _copies(buf_idx, [0] * npages):
+            c.wait()
+
+    start_fetch(0, 0)
+    row_pos = (kv_len - w) + lax.broadcasted_iota(
+        jnp.int32, (wg, blk), 0) // g
+    qh = q_ref[0, 0].astype(dot_dtype)  # (WG, Dh)
+
+    def body(i, carry):
+        m_prev, l_prev, acc_prev = carry
+        buf_idx = lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_blocks)
+        def _():
+            start_fetch(i + 1, 1 - buf_idx)
+
+        wait_fetch(buf_idx)
+
+        col_pos = i * blk + lax.broadcasted_iota(jnp.int32, (wg, blk), 1)
+        mask = jnp.logical_and(col_pos <= row_pos, col_pos < kv_len)
+
+        cols = []
+        for p in range(npages):
+            kp = kbuf[buf_idx, p].astype(dot_dtype)  # (Dh, ps)
+            s_p = _dot(qh, kp, ((1,), (0,)))         # (WG, ps)
+            if int8_kv:
+                s_p = s_p * ksbuf[buf_idx, p]        # (1, ps) broadcast
+            cols.append(s_p)
+        qk = jnp.concatenate(cols, axis=1) * scale   # (WG, blk)
+        qk = jnp.where(mask, qk, NEG_INF)
+
+        m_cur = jnp.max(qk, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p_full = jnp.exp(qk - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p_full, axis=1, keepdims=True)
+        pv = jnp.zeros((wg, d), jnp.float32)
+        for p in range(npages):
+            p_blk = p_full[:, p * ps:(p + 1) * ps]
+            if int8_kv:
+                p_blk = p_blk * vsbuf[buf_idx, p]    # (1, ps) broadcast
+            vp = vbuf[buf_idx, p].astype(dot_dtype)
+            pv = pv + _dot(p_blk.astype(dot_dtype), vp, ((1,), (1,)))
+        return m_new, l_new, acc_prev * corr + pv
+
+    m0 = jnp.full((wg, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((wg, 1), jnp.float32)
+    a0 = jnp.zeros((wg, d), jnp.float32)
+    _, l_f, acc_f = lax.fori_loop(0, n_blocks, body, (m0, l0, a0))
+    o_ref[0, 0] = (acc_f / jnp.maximum(l_f, 1e-30)).astype(o_ref.dtype)
+
+
+def paged_attention_tp(q, k_pool, v_pool, lengths, tables, layer=0, *,
+                       mesh, axis_name: str = "tp", scale=None,
+                       pages_per_block: int = 4,
+                       interpret: bool | None = None,
+                       k_scale_pool=None, v_scale_pool=None):
+    """`paged_attention` under tensor parallelism: kv heads shard over
+    `axis_name`, each device runs the kernel on its local heads.
+
+    The kernel is embarrassingly parallel over kv heads (per-head
+    m/l/acc state, per-head page slices), so the tp split needs NO
+    collectives — the head-sharded output feeds the attention-out
+    projection, whose row-parallel matmul does the psum exactly as in
+    training. pallas_call cannot be partitioned automatically by jit
+    (hence shard_map); everything XLA-side in the serving path still
+    relies on plain propagation.
+
+    Constraints: tp must divide num_kv_heads (so each device owns whole
+    GQA groups — q heads are ordered kv-head-major, so a contiguous H
+    split aligns with the KH split).
+    """
+    from jax.sharding import PartitionSpec as P
+    try:  # jax >= 0.8
+        from jax import shard_map
+        no_check = {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+        no_check = {"check_rep": False}
+
+    kh = k_pool.shape[2]
+    h = q.shape[2]
+    ntp = mesh.shape[axis_name]
+    if kh % ntp or h % ntp:
+        raise ValueError(
+            f"tp={ntp} must divide num_kv_heads={kh} (and heads={h}) to "
+            "shard the paged-attention kernel")
+    head_spec = P(None, None, axis_name, None)
+    pool_spec = P(None, None, axis_name, None, None)
+    rep = P()
+    in_specs = [head_spec, pool_spec, pool_spec, rep, rep]
+    args = [q, k_pool, v_pool, lengths, tables]
+    if k_scale_pool is not None:
+        in_specs += [P(None, None, axis_name, None)] * 2
+        args += [k_scale_pool, v_scale_pool]
+
+    def local(q_l, k_l, v_l, lens, tabs, *scales):
+        return paged_attention(
+            q_l, k_l, v_l, lens, tabs, layer, scale=scale,
+            pages_per_block=pages_per_block, interpret=interpret,
+            k_scale_pool=scales[0] if scales else None,
+            v_scale_pool=scales[1] if scales else None)
+
+    return shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                     out_specs=head_spec, **no_check)(*args)
 
 
 # ---------------------------------------------------------------------------

@@ -96,6 +96,116 @@ def test_generate_prefix_caching_cli(tmp_path, capsys, devices8):
     assert fast == plain
 
 
+def test_generate_contiguous_matches_paged_default(tmp_path, capsys,
+                                                   devices8):
+    """The default (paged) and --contiguous backends must produce
+    identical greedy output through the CLI."""
+    from cloud_server_tpu.generate import main as generate_main
+
+    model = {"vocab_size": 259, "embed_dim": 32, "num_layers": 2,
+             "num_heads": 4, "num_kv_heads": 2, "head_dim": 8,
+             "mlp_dim": 64, "max_seq_len": 128, "dtype": "float32",
+             "param_dtype": "float32", "remat": "none"}
+    (tmp_path / "cfg.json").write_text(json.dumps({"model": model}))
+    base_args = ["--config", str(tmp_path / "cfg.json"),
+                 "--prompt", "abcd", "--prompt", "xyz",
+                 "--max-new", "8", "--temperature", "0"]
+    generate_main(base_args)
+    paged = capsys.readouterr().out
+    generate_main(base_args + ["--contiguous"])
+    contiguous = capsys.readouterr().out
+    assert paged == contiguous
+
+
+def test_generate_spec_drafts_cli(tmp_path, capsys, devices8):
+    """--spec-drafts (in-server speculation through the paged server)
+    must match the plain greedy path token-for-token."""
+    from cloud_server_tpu.generate import main as generate_main
+
+    model = {"vocab_size": 259, "embed_dim": 32, "num_layers": 2,
+             "num_heads": 4, "num_kv_heads": 2, "head_dim": 8,
+             "mlp_dim": 64, "max_seq_len": 128, "dtype": "float32",
+             "param_dtype": "float32", "remat": "none"}
+    (tmp_path / "cfg.json").write_text(json.dumps({"model": model}))
+    base_args = ["--config", str(tmp_path / "cfg.json"),
+                 "--prompt", "abab", "--max-new", "8", "--temperature", "0"]
+    generate_main(base_args)
+    plain = capsys.readouterr().out
+    generate_main(base_args + ["--spec-drafts", "2"])
+    spec = capsys.readouterr().out
+    assert spec == plain
+
+
+def test_serve_http_cli_paged(tmp_path):
+    """`generate --serve-http` must bring up the paged server end-to-end
+    as a real process: POST a prompt, stream tokens, clean shutdown."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    model = {"vocab_size": 259, "embed_dim": 32, "num_layers": 2,
+             "num_heads": 4, "num_kv_heads": 2, "head_dim": 8,
+             "mlp_dim": 64, "max_seq_len": 64, "dtype": "float32",
+             "param_dtype": "float32", "remat": "none"}
+    (tmp_path / "cfg.json").write_text(json.dumps({"model": model}))
+    env = dict(os.environ)
+    # never let the subprocess dial the TPU relay (sitecustomize does on
+    # import when this var is set; concurrent relay sessions wedge it)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cloud_server_tpu.generate",
+         "--config", str(tmp_path / "cfg.json"),
+         "--serve-http", "0", "--spec-drafts", "2", "--page-size", "8",
+         "--max-slots", "2"],
+        env=env, stderr=subprocess.PIPE, text=True)
+    try:
+        import queue
+        import threading
+        lines: queue.Queue = queue.Queue()
+
+        def _pump():
+            for ln in proc.stderr:
+                lines.put(ln)
+            lines.put(None)
+
+        threading.Thread(target=_pump, daemon=True).start()
+        address = None
+        deadline = time.time() + 120
+        # read through a queue so a silently-wedged child (no stderr
+        # output at all) fails at the deadline instead of hanging the
+        # suite on a blocking readline
+        while time.time() < deadline:
+            try:
+                line = lines.get(timeout=min(5.0, deadline - time.time()))
+            except queue.Empty:
+                continue
+            if line is None:
+                break
+            if "serving on http://" in line:
+                address = line.split("http://", 1)[1].split(" ")[0].strip()
+                break
+        assert address, "server never announced its address"
+        req = urllib.request.Request(
+            f"http://{address}/generate",
+            data=json.dumps({"prompt": "abcd",
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            lines = [json.loads(ln) for ln in resp if ln.strip()]
+        assert lines[-1]["done"] is True
+        assert len(lines[-1]["tokens"]) == 4
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def test_generate_quantized(tmp_path, capsys, devices8):
     """--quantize serves int8 weights end-to-end through the CLI."""
     from cloud_server_tpu.generate import main as generate_main

@@ -78,6 +78,45 @@ def test_kernel_interpret_short_lengths():
 
 
 
+@pytest.mark.parametrize("w", [48, 64])
+@pytest.mark.parametrize("h,kh", [(4, 4), (4, 2)])
+def test_wide_kernel_interpret_matches_dense(w, h, kh):
+    """w > 32 routes the grid-over-(slot, head) wide kernel — the
+    chunked-prefill path; parity with the dense reference."""
+    q, k_pool, v_pool, lengths, tables = _make_case(
+        jax.random.key(5), w=w, h=h, kh=kh, mp=16, num_pages=64)
+    for layer in range(k_pool.shape[0]):
+        got = paged_attention(q, k_pool, v_pool, lengths, tables, layer,
+                              pages_per_block=2, interpret=True)
+        want = _dense_ref(q, k_pool, v_pool, lengths, tables, layer)
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_wide_kernel_big_batch_narrow_window():
+    """b > 16 routes the wide kernel even at W=1 (the narrow kernel's
+    static slot unroll would bloat code size at serving batches)."""
+    q, k_pool, v_pool, lengths, tables = _make_case(
+        jax.random.key(6), b=20, w=1, mp=4, num_pages=96)
+    got = paged_attention(q, k_pool, v_pool, lengths, tables, 0,
+                          pages_per_block=2, interpret=True)
+    want = _dense_ref(q, k_pool, v_pool, lengths, tables, 0)
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_wide_kernel_int8():
+    q, k_pool, v_pool, lengths, tables = _make_case(
+        jax.random.key(7), w=48, mp=16, num_pages=64)
+    kq, ksc = quantize_pool(k_pool)
+    vq, vsc = quantize_pool(v_pool)
+    k_deq = (kq.astype(jnp.float32) * ksc[:, :, :, None, :])
+    v_deq = (vq.astype(jnp.float32) * vsc[:, :, :, None, :])
+    want = _dense_ref(q, k_deq, v_deq, lengths, tables, 1)
+    got = paged_attention(q, kq, vq, lengths, tables, 1,
+                          pages_per_block=2, interpret=True,
+                          k_scale_pool=ksc, v_scale_pool=vsc)
+    np.testing.assert_allclose(got, want, atol=5e-3, rtol=5e-3)
+
+
 @pytest.mark.parametrize("impl", ["xla", "kernel"])
 def test_int8_scales_paths(impl):
     q, k_pool, v_pool, lengths, tables = _make_case(jax.random.key(3), w=2)
@@ -113,3 +152,35 @@ def test_compiled_on_tpu_paged_attention():
                       v_pool.astype(jnp.float32), lengths, tables, 0)
     np.testing.assert_allclose(np.asarray(got, np.float32), want,
                                atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.skipif("config.getoption('--co', default=False)")
+def test_compiled_on_tpu_wide_kernel():
+    """Gated: the wide (grid) kernel's Mosaic lowering on chip, bf16 and
+    int8, at a prefill-chunk width."""
+    import os
+    if os.environ.get("CST_TPU_TESTS") != "1":
+        pytest.skip("TPU-gated (set CST_TPU_TESTS=1)")
+    q, k_pool, v_pool, lengths, tables = _make_case(
+        jax.random.key(8), b=4, w=64, h=8, kh=8, d=64, ps=128, mp=4,
+        num_pages=32, dtype=jnp.bfloat16)
+    fn = jax.jit(functools.partial(paged_attention, pages_per_block=2,
+                                   interpret=False))
+    got = fn(q, k_pool, v_pool, lengths, tables, 0)
+    want = _dense_ref(q.astype(jnp.float32), k_pool.astype(jnp.float32),
+                      v_pool.astype(jnp.float32), lengths, tables, 0)
+    np.testing.assert_allclose(np.asarray(got, np.float32), want,
+                               atol=2e-2, rtol=2e-2)
+
+    kq, ksc = quantize_pool(k_pool.astype(jnp.float32))
+    vq, vsc = quantize_pool(v_pool.astype(jnp.float32))
+    got8 = jax.jit(functools.partial(
+        paged_attention, pages_per_block=2, interpret=False))(
+            q, kq, vq, lengths, tables, 0,
+            k_scale_pool=ksc, v_scale_pool=vsc)
+    k_deq = (kq.astype(jnp.float32) * ksc[:, :, :, None, :])
+    v_deq = (vq.astype(jnp.float32) * vsc[:, :, :, None, :])
+    want8 = _dense_ref(q.astype(jnp.float32), k_deq, v_deq, lengths,
+                       tables, 0)
+    np.testing.assert_allclose(np.asarray(got8, np.float32), want8,
+                               atol=5e-2, rtol=5e-2)

@@ -59,3 +59,68 @@ def test_server_tp_sharded_matches_single_device(devices8):
     srv = InferenceServer(params, TINY, icfg, max_slots=2, max_len=32)
     got = srv.generate(prompts, max_new_tokens=8)
     assert got == want
+
+
+# -- paged server ------------------------------------------------------------
+
+PAGED_KW = dict(max_slots=2, max_context=64, page_size=8, prefill_chunk=16,
+                prompt_buckets=[16])
+_ICFG = InferConfig(max_decode_len=8, temperature=0.0, eos_token_id=-1,
+                    pad_token_id=0)
+_PROMPTS = [[3, 7, 11], [9, 1, 4, 8, 2]]
+
+
+def _paged_single_device_reference(cfg=TINY, **kw):
+    from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+    srv = PagedInferenceServer(
+        transformer.init_params(TINY, jax.random.key(0)), cfg, _ICFG,
+        **PAGED_KW, **kw)
+    return srv.generate(_PROMPTS, max_new_tokens=8)
+
+
+def test_paged_server_tp_sharded_matches_single_device(devices8):
+    """tp/fsdp-sharded params through the PAGED server (XLA decode
+    path): page pools shard on kv heads, outputs match single-device
+    exactly — plain and speculative decode."""
+    from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+
+    want = _paged_single_device_reference()
+    mesh = make_mesh(MeshConfig(fsdp=2, tp=4))
+    params = _sharded_params(mesh)
+    srv = PagedInferenceServer(params, TINY, _ICFG, mesh=mesh, **PAGED_KW)
+    assert srv.generate(_PROMPTS, max_new_tokens=8) == want
+
+    spec = PagedInferenceServer(params, TINY, _ICFG, mesh=mesh,
+                                spec_drafts=2, **PAGED_KW)
+    assert spec.generate(_PROMPTS, max_new_tokens=8) == want
+
+
+def test_paged_server_tp_pallas_kernel_matches(devices8):
+    """The pallas paged-attention kernel under shard_map (kv heads over
+    tp) matches the single-device kernel path exactly."""
+    import dataclasses
+
+    from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+    cfg = dataclasses.replace(TINY, decode_attention_impl="pallas")
+
+    want = _paged_single_device_reference(cfg=cfg)
+    assert want == _paged_single_device_reference()  # kernel == XLA
+
+    mesh = make_mesh(MeshConfig(fsdp=2, tp=4))
+    params = _sharded_params(mesh)
+    srv = PagedInferenceServer(params, cfg, _ICFG, mesh=mesh, **PAGED_KW)
+    assert srv.generate(_PROMPTS, max_new_tokens=8) == want
+
+
+def test_paged_kernel_tp_rejects_indivisible_heads(devices8):
+    import dataclasses
+
+    import pytest
+
+    from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+    cfg = dataclasses.replace(TINY, num_kv_heads=2, decode_attention_impl="pallas")
+    mesh = make_mesh(MeshConfig(fsdp=2, tp=4))
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        PagedInferenceServer(
+            transformer.init_params(cfg, jax.random.key(0)), cfg, _ICFG,
+            mesh=mesh, **PAGED_KW)
