@@ -296,6 +296,7 @@ def serving_bench():
     # void the headline rows already measured
     for section in (lambda: _admission_churn_bench(params_bf16, base,
                                                    infer_cfg),
+                    _trained_spec_bench,
                     _longcontext_attention_bench):
         try:
             out.update(section())
@@ -308,10 +309,15 @@ def serving_bench():
 def _admission_churn_bench(params, base, infer_cfg):
     """Continuous batching under churn: requests arrive in waves while
     others decode — admissions (chunked prefill) interleave with decode
-    dispatches. Reports completed-token throughput over the whole run and
-    the number of decode dispatches that ran while admissions were in
-    flight (the chunked-prefill interleaving the contiguous server cannot
-    do)."""
+    dispatches.
+
+    The scenario runs TWICE: once untimed to compile every dispatch
+    shape it triggers (r3's churn_tok_s=2.4 timed ~370 s of remote
+    Mosaic compiles, not serving), then timed with all shapes warm.
+    Reports completed-token throughput, interleaved-decode count, and
+    the request-level latencies chunked prefill exists to bound: TTFT
+    for the long prompts that land mid-decode, and inter-token-latency
+    percentiles for the requests decoding while those admissions run."""
     import dataclasses
 
     import numpy as np
@@ -319,36 +325,192 @@ def _admission_churn_bench(params, base, infer_cfg):
     from cloud_server_tpu.inference.paged_server import PagedInferenceServer
 
     cfg = dataclasses.replace(base, decode_attention_impl="pallas")
-    srv = PagedInferenceServer(
-        params, cfg, infer_cfg, max_slots=8, max_context=1024,
-        page_size=128, prefill_chunk=256, decode_chunk=8,
-        prompt_buckets=[64, 256, 512])
-    rng = np.random.RandomState(0)
 
-    def mk_prompt(n):
-        return [int(x) for x in rng.randint(1, 30000, size=n)]
+    def scenario():
+        srv = PagedInferenceServer(
+            params, cfg, infer_cfg, max_slots=8, max_context=1024,
+            page_size=128, prefill_chunk=256, decode_chunk=8,
+            prompt_buckets=[64, 256, 512])
+        rng = np.random.RandomState(0)
 
-    reqs = [srv.submit(mk_prompt(64), max_new_tokens=64) for _ in range(8)]
-    for _ in range(2):
-        srv.step()
-    t0 = time.perf_counter()
-    interleaved = 0
-    # three waves of long-prompt arrivals while the first batch decodes
-    for wave in range(3):
-        reqs += [srv.submit(mk_prompt(400), max_new_tokens=32)
-                 for _ in range(4)]
-        for _ in range(6):
-            admitting = bool(srv._jobs) or srv.num_pending > 0
+        def mk_prompt(n):
+            return [int(x) for x in rng.randint(1, 30000, size=n)]
+
+        first = [srv.submit(mk_prompt(64), max_new_tokens=64)
+                 for _ in range(8)]
+        for _ in range(2):
             srv.step()
-            if admitting and srv.active.any():
-                interleaved += 1
-    srv.run_until_idle()
-    dt = time.perf_counter() - t0
-    total = sum(len(r.tokens) for r in reqs)
-    srv.stop()
-    print(f"[serving_bench] churn_tok_s: {total / dt:.1f}", flush=True)
-    return {"churn_tok_s": total / dt,
-            "churn_decode_steps_during_admission": interleaved}
+        t0 = time.perf_counter()
+        interleaved = 0
+        waves = []
+        # three waves of long-prompt arrivals while the first batch decodes
+        for _ in range(3):
+            waves += [srv.submit(mk_prompt(400), max_new_tokens=32)
+                      for _ in range(4)]
+            for _ in range(6):
+                admitting = bool(srv._jobs) or srv.num_pending > 0
+                srv.step()
+                if admitting and srv.active.any():
+                    interleaved += 1
+        srv.run_until_idle()
+        dt = time.perf_counter() - t0
+        srv.stop()
+        return first, waves, dt, interleaved
+
+    scenario()  # warm-up: every prefill/decode shape compiles here
+    first, waves, dt, interleaved = scenario()
+
+    total = sum(len(r.tokens) for r in first + waves)
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    ttfts = [r.emit_times[0] - r.submit_time
+             for r in waves if r.emit_times]
+    itls = []
+    for r in first:
+        itls += [b - a for a, b in zip(r.emit_times, r.emit_times[1:])]
+    out = {"churn_tok_s": total / dt,
+           "churn_decode_steps_during_admission": interleaved,
+           "churn_ttft_ms_p50": pct(ttfts, 0.50) * 1e3,
+           "churn_ttft_ms_p95": pct(ttfts, 0.95) * 1e3,
+           "churn_itl_ms_p50": pct(itls, 0.50) * 1e3,
+           "churn_itl_ms_p99": pct(itls, 0.99) * 1e3}
+    print(f"[serving_bench] churn_tok_s: {out['churn_tok_s']:.1f} "
+          f"ttft_ms p50/p95: {out['churn_ttft_ms_p50']:.0f}/"
+          f"{out['churn_ttft_ms_p95']:.0f} "
+          f"itl_ms p50/p99: {out['churn_itl_ms_p50']:.1f}/"
+          f"{out['churn_itl_ms_p99']:.1f}", flush=True)
+    return out
+
+
+def _trained_spec_bench():
+    """Speculative decoding measured on a TRAINED model + natural text.
+
+    r3's acceptance numbers came from an untrained model decoding
+    greedily — which collapses to repetition on ANY prompt, so its
+    'random-prompt' row measured the same degenerate regime. Here the
+    framework's own pipeline (byte tokenizer -> memmap -> training
+    loop) trains a small byte-level LM on this repo's source code
+    (tests/ held out), plus a 4x-smaller draft model, then serves
+    held-out code through the paged server three ways: plain, n-gram
+    speculation, and in-server draft-model speculation. Acceptance
+    rates are per committed-tokens-per-round (1.0 = no speculation
+    win).
+
+    Read the ACCEPT columns, not tok/s: this model is deliberately tiny
+    (trainable inside the bench), so serving it is per-dispatch-overhead
+    bound and the (G+1)-token verify window (plus G+1 draft forwards on
+    the draft row) costs several thin-model forwards' overhead for <3x
+    the tokens — speculation cannot pay that back HERE. The 330M
+    `decode_tok_s_pallas_spec_*` rows are where the wall-clock win
+    lives (weights-streaming-bound, window nearly free); this section's
+    job is the acceptance evidence the r3 bench lacked: a TRAINED model
+    on natural held-out text (r4 measured: n-gram 1.64, draft-model
+    2.63 committed tokens/round)."""
+    import dataclasses
+    import glob as _glob
+
+    import numpy as np
+
+    from cloud_server_tpu.config import InferConfig, ModelConfig, TrainConfig
+    from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+    from cloud_server_tpu.parallel.mesh import make_mesh
+    from cloud_server_tpu.config import MeshConfig
+    from cloud_server_tpu.training import init_train_state, make_train_step
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = sorted(_glob.glob(os.path.join(here, "cloud_server_tpu", "**",
+                                         "*.py"), recursive=True))
+    corpus = b"".join(open(f, "rb").read() for f in src)
+    held = sorted(_glob.glob(os.path.join(here, "tests", "*.py")))
+    held_text = b"".join(open(f, "rb").read() for f in held)
+    data = np.frombuffer(corpus, np.uint8).astype(np.int32)
+
+    seq = 256
+
+    def train_one(cfg, steps, seed):
+        mesh = make_mesh(MeshConfig())
+        tcfg = TrainConfig(batch_size=16, seq_len=seq, warmup_steps=20,
+                           total_steps=steps, learning_rate=3e-3)
+        state = init_train_state(cfg, tcfg, mesh, jax.random.key(seed))
+        step, batch_sharding = make_train_step(cfg, tcfg, mesh)
+        rng = np.random.RandomState(seed)
+        loss = None
+        for i in range(steps):
+            starts = rng.randint(0, len(data) - seq - 1, size=16)
+            toks = np.stack([data[s:s + seq] for s in starts])
+            state, metrics = step(state, {"tokens": jnp.asarray(toks)})
+            if i == steps - 1:
+                loss = float(jax.device_get(metrics["loss"]))
+        print(f"[trained_spec] trained {cfg.num_layers}L/"
+              f"{cfg.embed_dim}d {steps} steps, final loss {loss:.3f}",
+              flush=True)
+        return jax.device_get(state.params)
+
+    target_cfg = ModelConfig(
+        vocab_size=259, embed_dim=256, num_layers=4, num_heads=4,
+        num_kv_heads=4, head_dim=64, mlp_dim=1024, max_seq_len=1024,
+        dtype="bfloat16", param_dtype="float32", remat="none")
+    draft_cfg = dataclasses.replace(target_cfg, embed_dim=128,
+                                    num_layers=1, mlp_dim=512)
+    t_params = train_one(target_cfg, 400, 0)
+    d_params = train_one(draft_cfg, 400, 1)
+
+    # held-out natural prompts: code text the model never trained on
+    hrng = np.random.RandomState(3)
+    prompts = []
+    for _ in range(8):
+        s = hrng.randint(0, len(held_text) - 129)
+        prompts.append([int(b) for b in held_text[s:s + 128]])
+    greedy = InferConfig(max_decode_len=256, temperature=0.0,
+                         eos_token_id=-1, pad_token_id=0)
+    serve_cfg = dataclasses.replace(target_cfg,
+                                    decode_attention_impl="pallas")
+
+    out = {}
+
+    def run(tag, spec, draft=False):
+        srv = PagedInferenceServer(
+            t_params, serve_cfg, greedy, max_slots=8, max_context=512,
+            page_size=128, prefill_chunk=256, decode_chunk=16,
+            spec_drafts=spec, prompt_buckets=[128],
+            draft_params=d_params if draft else None,
+            draft_cfg=draft_cfg if draft else None)
+
+        def full_run():
+            for p in prompts:
+                srv.submit(p, max_new_tokens=256)
+            before, r0, c0 = (srv.tokens_emitted, srv.decode_rounds,
+                              srv.decode_tokens_committed)
+            t0 = time.perf_counter()
+            srv.run_until_idle()
+            dt = time.perf_counter() - t0
+            return (srv.tokens_emitted - before,
+                    srv.decode_rounds - r0,
+                    srv.decode_tokens_committed - c0, dt)
+
+        # untimed pass compiles every dispatch the run triggers — the
+        # round count shrinks (16 -> 8 -> ... -> 1) as budgets drain,
+        # and each count is its own remote Mosaic compile; the timed
+        # pass then measures serving, not compilation
+        full_run()
+        toks, rounds, committed, dt = full_run()
+        out[tag] = toks / dt
+        if spec:
+            out[tag + "_accept"] = committed / max(rounds, 1)
+            print(f"[trained_spec] {tag}: {out[tag]:.1f} tok/s, "
+                  f"accept {out[tag + '_accept']:.2f}", flush=True)
+        else:
+            print(f"[trained_spec] {tag}: {out[tag]:.1f} tok/s",
+                  flush=True)
+        srv.stop()
+
+    run("trained_tok_s_plain", 0)
+    run("trained_tok_s_ngram_spec", 3)
+    run("trained_tok_s_draft_spec", 3, draft=True)
+    return out
 
 
 def _longcontext_attention_bench():

@@ -94,15 +94,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="paged server: admission window width — long "
                    "prompts prefill in chunks this wide, interleaved with "
                    "decode dispatches so inter-token latency stays bounded")
+    p.add_argument("--allocation", choices=["ondemand", "reserve"],
+                   default="ondemand",
+                   help="paged server page policy: 'ondemand' grows "
+                   "chains per dispatch and preempts the youngest slot "
+                   "on pool exhaustion (higher concurrency per GB); "
+                   "'reserve' pre-reserves prompt+max_new at admission "
+                   "(no preemption)")
     p.add_argument("--decode-impl", choices=["xla", "pallas"], default=None,
                    help="decode-attention implementation override; "
                    "'pallas' selects the paged-attention kernel "
                    "(paged server on TPU — length-bounded page reads beat "
                    "the XLA gather on ragged contexts)")
     p.add_argument("--draft-config", metavar="JSON",
-                   help="speculative decoding: JSON config (model section) "
-                   "of a small draft model sharing the tokenizer; batch "
-                   "mode only")
+                   help="speculative decoding with a small draft model "
+                   "sharing the tokenizer (JSON config, model section). "
+                   "Batch mode: the standalone speculative batch API. "
+                   "--serve-http (paged): IN-SERVER draft-model "
+                   "speculation — the draft keeps its own paged cache "
+                   "and proposes --num-draft tokens per round")
     p.add_argument("--draft-checkpoint-dir",
                    help="draft model checkpoint (omit: random init — only "
                    "useful for smoke tests)")
@@ -185,10 +195,10 @@ def main(argv=None) -> None:
             "--spec-drafts is the paged server's in-server speculation; "
             "it cannot run with --contiguous (use --ngram-draft/"
             "--draft-config for the batch API instead)")
-    if args.spec_drafts and (args.draft_config or args.ngram_draft):
+    if args.spec_drafts and args.ngram_draft:
         raise SystemExit(
-            "--spec-drafts (in-server) and --draft-config/--ngram-draft "
-            "(batch API) are mutually exclusive speculation paths")
+            "--spec-drafts (in-server n-gram) and --ngram-draft (batch "
+            "API) are mutually exclusive speculation paths")
     tok = get_tokenizer(args.tokenizer)
     if tok.vocab_size > model_cfg.vocab_size:
         raise SystemExit(
@@ -263,6 +273,21 @@ def main(argv=None) -> None:
         eos_token_id=tok.eos_id if tok.eos_id is not None else -1,
         pad_token_id=tok.pad_id or 0)
 
+    def load_draft():
+        """Draft model for in-server speculation (--draft-config with
+        the paged server). Returns (params, cfg) or (None, None)."""
+        if not args.draft_config or args.contiguous:
+            return None, None
+        with open(args.draft_config) as f:
+            draft_cfg = from_json(ModelConfig, json.load(f).get("model", {}))
+        draft_module = None
+        if draft_cfg.num_experts >= 2:
+            from cloud_server_tpu.models import moe as draft_module
+        draft_params = load_params(draft_cfg, args.draft_checkpoint_dir,
+                                   None, args.seed + 1,
+                                   loss_fn_module=draft_module)
+        return draft_params, draft_cfg
+
     def make_server(max_len: int, max_slots: int):
         """Build the serving backend: paged by default, contiguous on
         --contiguous. Same client API either way (submit / generate /
@@ -286,6 +311,10 @@ def main(argv=None) -> None:
         ps = args.page_size
         max_context = -(-max_len // ps) * ps  # round up to a page multiple
         prefill_chunk = -(-max(ps, args.prefill_chunk) // ps) * ps
+        draft_params, draft_cfg = load_draft()
+        spec = args.spec_drafts
+        if draft_cfg is not None and spec == 0:
+            spec = args.num_draft  # --draft-config implies speculation
         from cloud_server_tpu.inference.paged_server import (
             PagedInferenceServer)
         return PagedInferenceServer(
@@ -293,15 +322,17 @@ def main(argv=None) -> None:
             max_context=max_context, page_size=ps,
             num_pages=args.num_pages or None,
             decode_chunk=args.decode_chunk,
-            spec_drafts=args.spec_drafts,
-            prefill_chunk=prefill_chunk, seed=args.seed)
+            spec_drafts=spec,
+            prefill_chunk=prefill_chunk, seed=args.seed,
+            allocation=args.allocation,
+            draft_params=draft_params, draft_cfg=draft_cfg)
 
     if args.serve_http is not None:
-        if args.draft_config or args.ngram_draft:
+        if args.ngram_draft or (args.draft_config and args.contiguous):
             raise SystemExit(
-                "--draft-config/--ngram-draft are batch-mode only; "
-                "--serve-http would silently serve without speculation "
-                "(the serving-path flag is --spec-drafts)")
+                "--ngram-draft is batch-mode only (the serving "
+                "equivalent is --spec-drafts), and --draft-config "
+                "serving needs the paged server (drop --contiguous)")
         from cloud_server_tpu.inference.http_server import HttpFrontend
         max_len = args.max_len or model_cfg.max_seq_len
         srv = make_server(max_len, args.max_slots).start()

@@ -1,10 +1,12 @@
 """Host-side page allocator: refcounts, prefix sharing, LRU reuse.
 
 Pure-Python bookkeeping for the device page pool
-(`paged_engine.PagedKVCache`). The device never allocates — the scheduler
-reserves every page a request can touch at admission time (prompt +
-max_new_tokens + speculative slack), so a request can never OOM
-mid-decode and no preemption path is needed.
+(`paged_engine.PagedKVCache`). The device never allocates — the
+scheduler either reserves a request's whole chain at admission
+(allocation="reserve") or grows chains just-in-time before each decode
+dispatch, preempting the youngest slot on exhaustion
+(allocation="ondemand" — see paged_server). Either way every write the
+device issues lands in a page the host put in the table first.
 
 Sharing model (radix-style, page granularity): a FULL page of kv is
 identified by the token chain that produced it — the cache key is

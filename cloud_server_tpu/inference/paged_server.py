@@ -39,9 +39,17 @@ writes through sentinel tables drop):
   * decode dispatches get SENTINEL table rows for every non-live slot, so
     a slot mid-admission can never have its freshly prefilled pages
     clobbered by the concurrent batch-wide decode window;
-  * page chains are fully reserved at admission (prompt + max_new +
-    window slack), so decode never outgrows its chain and there is no
-    mid-flight OOM/preemption path.
+  * a slot's chain always covers its next dispatch's window writes —
+    either reserved whole at admission (allocation="reserve": prompt +
+    max_new + window slack, no mid-flight OOM possible) or grown
+    just-in-time per dispatch (allocation="ondemand", the default:
+    admission takes prompt + one window; `_extend_chains` allocates
+    ahead of each decode dispatch and, on pool exhaustion, preempts the
+    youngest slot — its pages release into the radix cache and its
+    request requeues as a continuation whose re-prefill is mostly cache
+    hits). On-demand never parks worst-case max_new headroom, so
+    sustained concurrency at equal HBM is strictly higher
+    (tests/test_paged_server.py::test_ondemand_concurrency_beyond_reservation).
 """
 
 from __future__ import annotations
@@ -49,6 +57,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+import time
 from functools import partial
 from typing import Sequence
 
@@ -60,11 +69,12 @@ from jax import lax
 from cloud_server_tpu.config import InferConfig, ModelConfig
 from cloud_server_tpu.inference import paged_engine
 from cloud_server_tpu.inference.block_allocator import BlockAllocator
-from cloud_server_tpu.inference.sampling import sample_logits, sampling_probs
+from cloud_server_tpu.inference.sampling import (
+    sample_from_probs, sample_logits, sampling_probs)
 from cloud_server_tpu.inference.server import (
     Request, _bucket, _token_logprobs)
 from cloud_server_tpu.inference.speculative import (
-    _accept_point_mass, _ngram_drafts)
+    _accept_drafts, _accept_point_mass, _ngram_drafts)
 
 
 def _pow2_buckets(lo: int, hi: int) -> list[int]:
@@ -102,12 +112,14 @@ def _split_cache(cache):
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "infer_cfg", "scatter_prompt", "mesh"),
+         static_argnames=("cfg", "infer_cfg", "scatter_prompt", "mesh",
+                          "draft_cfg"),
          donate_argnums=(1,))
 def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
-                   slot_ids, prompt_rows, prompt_lens, rng, *,
+                   slot_ids, prompt_rows, prompt_lens, rng,
+                   draft_params=None, *,
                    cfg: ModelConfig, infer_cfg: InferConfig,
-                   scatter_prompt: bool, mesh=None):
+                   scatter_prompt: bool, mesh=None, draft_cfg=None):
     """One admission chunk for a (padded) G-row group.
 
     chunk: (G, Wc) tokens for positions [g_lens, g_lens + Wc) per row —
@@ -126,6 +138,18 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
         params, chunk, cfg, cache, logits_at=sample_at, mesh=mesh)
     toks = sample_logits(logits, rng, infer_cfg)
     lps = _token_logprobs(logits, toks)
+    new_state = dict(state)
+    new_state["pools"] = _split_cache(cache)
+    if draft_cfg is not None:
+        # the draft model prefills the same chunk into ITS pools (same
+        # page ids / tables, draft geometry) so in-server draft-model
+        # speculation has the full context cached — including shared
+        # prefix pages, which carry the draft kv alongside the target's
+        dcache = _make_cache(state["draft_pools"], g_lens, g_tables)
+        _, dcache = paged_engine.window_forward(
+            draft_params, chunk, draft_cfg, dcache, logits_at=None,
+            mesh=mesh)
+        new_state["draft_pools"] = _split_cache(dcache)
     hist = state["hist"]
     if scatter_prompt:
         pb = prompt_rows.shape[1]
@@ -133,7 +157,8 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
         cols = jnp.where(cols < prompt_lens[:, None], cols, hist.shape[1])
         hist = hist.at[slot_ids[:, None], cols].set(prompt_rows,
                                                     mode="drop")
-    return {"pools": _split_cache(cache), "hist": hist}, toks, lps
+    new_state["hist"] = hist
+    return new_state, toks, lps
 
 
 @partial(jax.jit,
@@ -175,23 +200,38 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
     (lengths, last, hist, pools), out = lax.scan(
         body, (lengths, last_token, state["hist"], state["pools"]),
         jax.random.split(rng, n_rounds))
-    return {"pools": pools, "hist": hist}, lengths, last, out
+    new_state = dict(state)
+    new_state["pools"] = pools
+    new_state["hist"] = hist
+    return new_state, lengths, last, out
 
 
 @partial(jax.jit,
          static_argnames=("cfg", "infer_cfg", "n_rounds", "n_drafts",
-                          "mesh"),
+                          "mesh", "draft_cfg"),
          donate_argnums=(1,))
 def _spec_rounds(params, state, lengths, tables, last_token, live,
-                 stop_len, rng, *, cfg: ModelConfig, infer_cfg: InferConfig,
-                 n_rounds: int, n_drafts: int, mesh=None):
+                 stop_len, rng, draft_params=None, *, cfg: ModelConfig,
+                 infer_cfg: InferConfig, n_rounds: int, n_drafts: int,
+                 mesh=None, draft_cfg=None):
     """n_rounds speculative rounds in one dispatch.
 
-    Each round drafts `n_drafts` tokens per slot from its device-resident
-    history (prompt-lookup n-grams), scores the (drafts+1)-token window in
-    ONE batched window_forward, and commits each slot's accepted prefix
-    plus the corrective/bonus token (exact accept rule). Commits are
+    Each round drafts `n_drafts` tokens per slot — from a DRAFT MODEL
+    decoding against its own paged cache (draft_params/draft_cfg;
+    classic speculative decoding) or from the slot's device-resident
+    history (prompt-lookup n-grams) — scores the (drafts+1)-token window
+    in ONE batched window_forward, and commits each slot's accepted
+    prefix plus the corrective/bonus token (exact accept rule — see
+    speculative._accept_drafts / _accept_point_mass). Commits are
     capped at stop_len so a slot never outruns its page chain.
+
+    Draft-model cache discipline (mirrors speculative_generate): G+1
+    draft decode steps per round — step j writes the draft kv of its
+    input token at position lengths + j, so accepted positions are
+    already cached and the corrective token's kv lands when the next
+    round's step 0 feeds it. Stale draft entries past the commit point
+    are masked by lengths and overwritten by later rounds, exactly like
+    the target pool.
 
     Returns (state', lengths', last',
     (toks (R, B, G+1), lps (R, B, G+1), counts (R, B))).
@@ -201,10 +241,11 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
     pad = infer_cfg.pad_token_id
     batch_idx = jnp.arange(b)
     j = jnp.arange(g + 1)[None, :]
+    use_draft = draft_cfg is not None
 
     def body(carry, rng_t):
-        lengths, last, hist, pools = carry
-        rng_acc, _ = jax.random.split(rng_t)
+        lengths, last, hist, pools, dpools = carry
+        rng_acc, rng_draft = jax.random.split(rng_t)
         can_commit = live & (lengths < stop_len)
 
         # `last` is the committed token at sequence position `lengths`;
@@ -213,8 +254,34 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
         cols_last = jnp.where(live, lengths, hist.shape[1])
         hist = hist.at[batch_idx, cols_last].set(last, mode="drop")
         valid = lengths + 1  # committed tokens = [0, lengths] incl. last
-        t_prev2 = hist[batch_idx, jnp.maximum(valid - 2, 0)]
-        drafts = _ngram_drafts(hist, valid, t_prev2, last, g, pad)
+        if use_draft:
+            def d_step(dc, inp):
+                tok, off, rng_d = inp
+                dcache = _make_cache(dc, lengths + off, tables)
+                dlogits, dcache = paged_engine.window_forward(
+                    draft_params, tok[:, None], draft_cfg, dcache,
+                    logits_at=jnp.zeros_like(lengths), mesh=mesh)
+                qp = sampling_probs(dlogits, infer_cfg)
+                nxt = sample_from_probs(qp, rng_d)
+                return _split_cache(dcache), (nxt, qp)
+
+            # inputs step j: the token at position lengths + j; step 0
+            # feeds `last`, later steps feed the previous step's sample
+            # — expressed as a scan whose carried token rides in the
+            # iteration outputs, so unroll manually (G is tiny/static)
+            toks_j, qps = [], []
+            tok = last
+            for step in range(g + 1):
+                rng_draft, rd = jax.random.split(rng_draft)
+                dpools, (tok, qp) = d_step(
+                    dpools, (tok, jnp.int32(step), rd))
+                toks_j.append(tok)
+                qps.append(qp)
+            drafts = jnp.stack(toks_j[:g], axis=1)        # (B, G)
+            q_probs = jnp.stack(qps[:g], axis=1)          # (B, G, V)
+        else:
+            t_prev2 = hist[batch_idx, jnp.maximum(valid - 2, 0)]
+            drafts = _ngram_drafts(hist, valid, t_prev2, last, g, pad)
         window = jnp.concatenate([last[:, None], drafts], axis=1)
 
         cache = _make_cache(pools, lengths, tables)
@@ -222,7 +289,10 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
             params, window, cfg, cache, logits_at=None, all_logits=True,
             mesh=mesh)
         p_probs = sampling_probs(vlogits, infer_cfg)  # (B, G+1, V)
-        n_acc, x = _accept_point_mass(drafts, p_probs, rng_acc)
+        if use_draft:
+            n_acc, x = _accept_drafts(drafts, q_probs, p_probs, rng_acc)
+        else:
+            n_acc, x = _accept_point_mass(drafts, p_probs, rng_acc)
 
         drafts_x = jnp.concatenate([drafts, x[:, None]], axis=1)
         committed = jnp.where(j < n_acc[:, None], drafts_x,
@@ -245,13 +315,19 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
         hist = hist.at[batch_idx[:, None], cols].set(toks, mode="drop")
         last_idx = jnp.maximum(count - 1, 0)
         last2 = jnp.where(count > 0, committed[batch_idx, last_idx], last)
-        return ((new_len, last2, hist, _split_cache(cache)),
+        return ((new_len, last2, hist, _split_cache(cache), dpools),
                 (toks, lps, count))
 
-    (lengths, last, hist, pools), out = lax.scan(
-        body, (lengths, last_token, state["hist"], state["pools"]),
+    (lengths, last, hist, pools, dpools), out = lax.scan(
+        body, (lengths, last_token, state["hist"], state["pools"],
+               state.get("draft_pools")),
         jax.random.split(rng, n_rounds))
-    return {"pools": pools, "hist": hist}, lengths, last, out
+    new_state = dict(state)
+    new_state["pools"] = pools
+    new_state["hist"] = hist
+    if dpools is not None:
+        new_state["draft_pools"] = dpools
+    return new_state, lengths, last, out
 
 
 # ---------------------------------------------------------------------------
@@ -262,10 +338,12 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
 @dataclasses.dataclass
 class _Slot:
     req: Request
-    prompt: list[int]
-    pages: list[int]            # full chain, shared prefix first
+    prompt: list[int]           # admission prompt (original + any tokens
+    #                             generated before a preemption)
+    pages: list[int]            # chain so far, shared prefix first
     shared_len: int
     stop_len: int               # prompt + max_new (absolute positions)
+    admit_seq: int = 0          # admission order — preemption picks max
 
 
 @dataclasses.dataclass
@@ -300,7 +378,9 @@ class PagedInferenceServer:
                  prompt_buckets: Sequence[int] | None = None,
                  decode_chunk: int = 8, spec_drafts: int = 0,
                  prefill_chunk: int = 256, seed: int = 0,
-                 mesh=None, tp_axis: str = "tp"):
+                 mesh=None, tp_axis: str = "tp",
+                 allocation: str = "ondemand",
+                 draft_params=None, draft_cfg: ModelConfig | None = None):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -342,6 +422,11 @@ class PagedInferenceServer:
         if prompt_buckets is None:
             prompt_buckets = _pow2_buckets(16, max_context)
         self.prompt_buckets = sorted(prompt_buckets)
+        # continuations (preempted requests re-admitted with their
+        # generated tokens appended) can exceed the client-facing
+        # buckets, so admission sizing always has max_context available
+        self._admit_buckets = sorted(set(self.prompt_buckets)
+                                     | {max_context})
         # remainders bucket to a pow2 <= prefill_chunk (single-chunk jobs)
         # or a prefill_chunk multiple (multi-chunk jobs) — chunk WIDTHS
         # stay a small fixed set, chunk COUNTS are host-side loops
@@ -360,6 +445,27 @@ class PagedInferenceServer:
                 f"tp={tp} must divide num_kv_heads={cfg.num_kv_heads} "
                 "for tensor-parallel paged serving")
 
+        # In-server draft-model speculation: a second (small) model
+        # drafts against its OWN paged pools, indexed by the SAME page
+        # tables/chains — one allocator covers both, and shared prefix
+        # pages carry draft kv alongside the target's.
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError("pass draft_params and draft_cfg together")
+        if draft_cfg is not None and spec_drafts <= 0:
+            raise ValueError("a draft model needs spec_drafts > 0")
+        if draft_cfg is not None and draft_cfg.vocab_size != cfg.vocab_size:
+            # fail at construction — a mismatch otherwise only explodes
+            # (shape error in the accept rule) at the first speculative
+            # dispatch, taking every in-flight request with it
+            raise ValueError(
+                f"draft vocab_size={draft_cfg.vocab_size} != target "
+                f"vocab_size={cfg.vocab_size}; speculative verification "
+                "compares their token distributions elementwise")
+        self.draft_cfg = draft_cfg
+        self.draft_params = (None if draft_params is None else jax.tree.map(
+            cast_leaf, draft_params,
+            is_leaf=lambda x: isinstance(x, QTensor)))
+
         cache = paged_engine.init_paged_cache(
             cfg, num_pages=num_pages, page_size=page_size, batch=max_slots,
             max_pages_per_slot=self.max_pages_per_slot)
@@ -367,21 +473,35 @@ class PagedInferenceServer:
             "pools": _split_cache(cache),
             "hist": jnp.zeros((max_slots, max_context), jnp.int32),
         }
+        if draft_cfg is not None:
+            dcache = paged_engine.init_paged_cache(
+                draft_cfg, num_pages=num_pages, page_size=page_size,
+                batch=max_slots,
+                max_pages_per_slot=self.max_pages_per_slot)
+            self.state["draft_pools"] = _split_cache(dcache)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             ax = tp_axis if tp > 1 else None
+            if (tp > 1 and draft_cfg is not None
+                    and draft_cfg.num_kv_heads % tp):
+                raise ValueError(
+                    f"tp={tp} must divide the draft model's num_kv_heads="
+                    f"{draft_cfg.num_kv_heads} too")
 
             def put(x, spec):
                 return jax.device_put(x, NamedSharding(mesh, spec))
 
-            self.state = {
-                "pools": {
+            def shard_pools(pools):
+                return {
                     name: put(pool,
                               P(None, None, ax, None, None)
                               if pool.ndim == 5 else P(None, None, ax, None))
-                    for name, pool in self.state["pools"].items()},
-                "hist": put(self.state["hist"], P()),
-            }
+                    for name, pool in pools.items()}
+
+            self.state = {
+                name: (shard_pools(val) if name.endswith("pools")
+                       else put(val, P()))
+                for name, val in self.state.items()}
         # host-authoritative scheduling state
         self.tables = np.full((max_slots, self.max_pages_per_slot),
                               num_pages, np.int32)
@@ -390,11 +510,30 @@ class PagedInferenceServer:
         self.last_token = np.zeros((max_slots,), np.int32)
         self.stop_len = np.zeros((max_slots,), np.int32)
 
+        # Page-allocation policy:
+        #   "ondemand" (default) — admission reserves only the prompt +
+        #     one decode window; decode dispatches extend each live
+        #     slot's chain just-in-time. On pool exhaustion the YOUNGEST
+        #     slot is preempted: its pages release into the radix cache
+        #     (content-keyed, fully written — valid KV), its request
+        #     requeues as a continuation (prompt + generated so far),
+        #     and re-admission re-prefills almost entirely from cache.
+        #     Worst-case max_new headroom is never parked, so sustained
+        #     concurrency is higher at equal HBM.
+        #   "reserve" — the r3 behavior: the whole chain (prompt +
+        #     max_new + window slack) reserved at admission; no
+        #     mid-flight preemption, lower host bookkeeping.
+        if allocation not in ("ondemand", "reserve"):
+            raise ValueError(f"unknown allocation policy: {allocation!r}")
+        self.allocation = allocation
+
         # speculative-efficiency counters: committed tokens per model
         # round (mean accepted length + 1); plain decode reports ~1.0
         self.decode_rounds = 0
         self.decode_tokens_committed = 0
         self.tokens_emitted = 0  # lifetime emitted tokens (bench/metrics)
+        self.preemptions = 0
+        self._admit_seq = 0
 
         self._slots: list[_Slot | None] = [None] * max_slots
         self._jobs: list[_AdmitJob] = []
@@ -423,7 +562,7 @@ class PagedInferenceServer:
                 f"prompt of {len(prompt)} tokens leaves no room to decode "
                 f"within max_context={self.max_context}")
         req = Request(prompt=list(prompt), max_new_tokens=max_new,
-                      stream=stream)
+                      stream=stream, submit_time=time.perf_counter())
         with self._lock:
             self._pending.append(req)
         return req
@@ -457,6 +596,7 @@ class PagedInferenceServer:
             req.finish_reason = "eos"
             return True
         req.tokens.append(token)
+        req.emit_times.append(time.perf_counter())
         self.tokens_emitted += 1
         req.logprobs.append(float(logprob))
         if req.stream is not None:
@@ -466,14 +606,36 @@ class PagedInferenceServer:
             return True
         return False
 
-    def _finish(self, slot_id: int) -> None:
+    def _committed(self, slot_id: int) -> list[int]:
+        """The slot's committed token stream, truncated to the device's
+        written-KV watermark (self.lengths). slot.prompt already folds
+        in any tokens generated before a preemption, so only tokens
+        generated SINCE this admission are appended. The truncation
+        matters at page boundaries: the newest sampled token has no KV
+        written yet (its window runs next dispatch), so releasing the
+        untruncated stream could key a full page whose final lane is
+        garbage — a future prefix hit would serve invalid KV."""
         slot = self._slots[slot_id]
-        committed = slot.prompt + slot.req.tokens
-        self.allocator.release(slot.pages, committed)
+        since = len(slot.prompt) - len(slot.req.prompt)
+        stream = slot.prompt + slot.req.tokens[since:]
+        return stream[:int(self.lengths[slot_id])]
+
+    def _release_slot(self, slot_id: int, keyed_tokens: list[int]) -> _Slot:
+        """The slot-teardown invariant, in ONE place: release the page
+        chain (keyed by `keyed_tokens` — pass [] to key nothing), clear
+        the slot, sentinel its table row, deactivate. Every path that
+        retires a slot (finish, preemption, failure) goes through here;
+        what happens to the request afterwards is the caller's story."""
+        slot = self._slots[slot_id]
+        self.allocator.release(slot.pages, keyed_tokens)
         self._slots[slot_id] = None
         self.tables[slot_id, :] = self.allocator.num_pages  # sentinel
         self.active[slot_id] = False
         self.lengths[slot_id] = 0
+        return slot
+
+    def _finish(self, slot_id: int) -> None:
+        slot = self._release_slot(slot_id, self._committed(slot_id))
         slot.req._done.set()
 
     # -- admission ----------------------------------------------------------
@@ -485,18 +647,31 @@ class PagedInferenceServer:
 
     def _start_admissions(self) -> None:
         """Pop pending requests into slots (pages permitting) and build
-        bucketed chunked-prefill jobs."""
+        bucketed chunked-prefill jobs.
+
+        A request that already carries generated tokens is a
+        CONTINUATION (it was preempted): its admission prompt is
+        prompt + tokens, so the prefix walk re-hits the pages its
+        preemption released into the cache and the sampled first token
+        is simply the next token of the stream."""
         staged: list[int] = []
         with self._lock:
             free = [i for i, s in enumerate(self._slots) if s is None]
             while self._pending and free:
                 req = self._pending[0]
-                shared, shared_len = self.allocator.lookup_prefix(req.prompt)
-                total = len(req.prompt) + req.max_new_tokens + self.window
+                prompt = list(req.prompt) + list(req.tokens)
+                remaining = req.max_new_tokens - len(req.tokens)
+                shared, shared_len = self.allocator.lookup_prefix(prompt)
+                if self.allocation == "ondemand":
+                    # prompt + one decode window; chains grow per
+                    # dispatch in _extend_chains
+                    total = len(prompt) + self.window
+                else:
+                    total = len(prompt) + remaining + self.window
                 need = -(-total // self.page_size) - len(shared)
                 fresh = self.allocator.alloc(max(0, need))
                 if fresh is None:
-                    self.allocator.release(shared, req.prompt[:shared_len])
+                    self.allocator.release(shared, prompt[:shared_len])
                     if self.num_active == 0 and not self._jobs:
                         # nothing running will ever free pages: the pool
                         # is simply too small for this request
@@ -509,9 +684,11 @@ class PagedInferenceServer:
                     break
                 self._pending.popleft()
                 slot_id = free.pop(0)
-                slot = _Slot(req=req, prompt=list(req.prompt),
+                self._admit_seq += 1
+                slot = _Slot(req=req, prompt=prompt,
                              pages=shared + fresh, shared_len=shared_len,
-                             stop_len=len(req.prompt) + req.max_new_tokens)
+                             stop_len=len(prompt) + remaining,
+                             admit_seq=self._admit_seq)
                 self._slots[slot_id] = slot
                 self.tables[slot_id, :] = self.allocator.num_pages
                 self.tables[slot_id, :len(slot.pages)] = slot.pages
@@ -533,7 +710,7 @@ class PagedInferenceServer:
             n_chunks = -(-rb // w)
             g = len(slot_ids)
             pb = _bucket(max(len(self._slots[s].prompt) for s in slot_ids),
-                         self.prompt_buckets)
+                         self._admit_buckets)
             job = _AdmitJob(
                 slots=list(slot_ids), chunk_w=w, n_chunks=n_chunks,
                 rows=np.full((g, n_chunks * w), pad_tok, np.int32),
@@ -585,8 +762,10 @@ class PagedInferenceServer:
             jnp.asarray(g_lens, jnp.int32), jnp.asarray(g_tables),
             jnp.asarray(sample_at, jnp.int32), jnp.asarray(slot_ids),
             jnp.asarray(prompt_rows), jnp.asarray(prompt_lens, jnp.int32),
-            self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg,
-            scatter_prompt=(c == 0), mesh=self.mesh)
+            self._next_rng(), self.draft_params,
+            cfg=self.cfg, infer_cfg=self.infer_cfg,
+            scatter_prompt=(c == 0), mesh=self.mesh,
+            draft_cfg=self.draft_cfg)
         toks, lps = jax.device_get((toks, lps))
         toks, lps = np.asarray(toks)[:g], np.asarray(lps)[:g]
         job.toks = np.where(in_range, toks, job.toks)
@@ -609,6 +788,80 @@ class PagedInferenceServer:
 
     # -- decode -------------------------------------------------------------
 
+    def _preempt_youngest(self, protect: int) -> bool:
+        """Free the youngest live slot's pages (content-keyed into the
+        radix cache — fully-written, valid KV) and requeue its request
+        at the FRONT of the queue as a continuation. Returns False when
+        no slot other than `protect` can be preempted."""
+        candidates = [sid for sid, s in enumerate(self._slots)
+                      if s is not None and self.active[sid]
+                      and sid != protect]
+        if not candidates:
+            return False
+        sid = max(candidates, key=lambda s: self._slots[s].admit_seq)
+        slot = self._release_slot(sid, self._committed(sid))
+        self.preemptions += 1
+        with self._lock:
+            self._pending.appendleft(slot.req)
+        return True
+
+    def _extend_chains(self, n_rounds: int) -> int:
+        """On-demand policy: before a decode dispatch of n_rounds, grow
+        every live slot's page chain to cover its worst-case window
+        writes (lengths + n_rounds * window, clamped past stop_len where
+        writes still span one final window).
+
+        Pool exhaustion is handled in escalating order: take whatever
+        pages ARE available (partial growth), preempt youngest-first,
+        and — when nothing is preemptable (e.g. the other slots are
+        still mid-admission) — BOUND this dispatch to the rounds every
+        chain already covers instead of killing anyone: exhaustion is
+        transient whenever admissions/queued work can free or activate
+        slots by the next step. Returns the dispatchable round count
+        (0 = skip this decode dispatch). A request is failed only when
+        nothing can ever change: it is alone, the pool is fully drained
+        into its chain, and it still cannot cover one round."""
+        n_eff = n_rounds
+        for sid in range(self.max_slots):
+            slot = self._slots[sid]
+            if slot is None or not self.active[sid]:
+                continue
+            while True:
+                need_len = min(
+                    int(self.lengths[sid]) + n_rounds * self.window,
+                    slot.stop_len + self.window)
+                delta = -(-need_len // self.page_size) - len(slot.pages)
+                if delta <= 0:
+                    break
+                grab = min(delta, self.allocator.available)
+                fresh = self.allocator.alloc(grab) if grab > 0 else None
+                if fresh:
+                    start = len(slot.pages)
+                    slot.pages.extend(fresh)
+                    self.tables[sid, start:len(slot.pages)] = fresh
+                    if grab == delta:
+                        break
+                    continue  # partial fill; loop tries preemption next
+                if self._preempt_youngest(protect=sid):
+                    continue
+                covered = len(slot.pages) * self.page_size
+                r_ok = max(0, (covered - int(self.lengths[sid]))
+                           // self.window)
+                if (r_ok == 0 and not self._jobs
+                        and self.num_pending == 0
+                        and self.allocator.available == 0
+                        and self.num_active == 1):
+                    # genuinely impossible: alone with the whole pool
+                    slot = self._release_slot(sid, self._committed(sid))
+                    slot.req.finish_reason = (
+                        "error: request needs more pages than the pool "
+                        "can ever provide")
+                    slot.req._done.set()
+                    break
+                n_eff = min(n_eff, r_ok)
+                break
+        return n_eff
+
     def _chunk_rounds(self) -> int:
         """Rounds this dispatch: bounded by decode_chunk and the tightest
         remaining budget (in rounds), rounded down to a power of two."""
@@ -625,6 +878,14 @@ class PagedInferenceServer:
 
     def _decode_dispatch(self) -> None:
         n = self._chunk_rounds()
+        if self.allocation == "ondemand":
+            n_eff = self._extend_chains(n)
+            if n_eff <= 0 or not self.active.any():
+                return  # transient page famine — admissions continue,
+                #         preemption candidates appear next step
+            while n > n_eff:  # keep round counts powers of two (compile
+                n //= 2      # cache) while honouring chain coverage
+            n = max(1, n)
         live = self.active.copy()
         # non-live slots (mid-admission or empty) must not write through
         # their real tables — the batch-wide window would clobber pages
@@ -637,8 +898,10 @@ class PagedInferenceServer:
             self.state, lens, last, (toks, lps, counts) = _spec_rounds(
                 self.params, self.state, *args,
                 jnp.asarray(self.stop_len), self._next_rng(),
+                self.draft_params,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
-                n_drafts=self.spec_drafts, mesh=self.mesh)
+                n_drafts=self.spec_drafts, mesh=self.mesh,
+                draft_cfg=self.draft_cfg)
             toks, lps, counts, lens, last = jax.device_get(
                 (toks, lps, counts, lens, last))
         else:
@@ -688,20 +951,16 @@ class PagedInferenceServer:
     def _fail_all(self, exc: BaseException) -> None:
         with self._lock:
             pending, self._pending = list(self._pending), collections.deque()
-        for sid, slot in enumerate(self._slots):
-            if slot is not None:
-                # release with tokens=[] — drops the refs (keeping the
+        for sid in range(self.max_slots):
+            if self._slots[sid] is not None:
+                # keyed_tokens=[] — drops the refs (keeping the
                 # allocator consistent for any future recovery path) but
                 # keys NOTHING: a failed dispatch may have left these
                 # pages half-written, so they must not enter the prefix
                 # cache as valid KV
-                self.allocator.release(slot.pages, [])
-                self.tables[sid, :] = self.allocator.num_pages
-                self.active[sid] = False
-                self.lengths[sid] = 0
+                slot = self._release_slot(sid, [])
                 slot.req.finish_reason = f"error: {exc!r}"
                 slot.req._done.set()
-                self._slots[sid] = None
         self._jobs.clear()
         for req in pending:
             req.finish_reason = f"error: {exc!r}"

@@ -39,6 +39,7 @@ import collections
 import dataclasses
 import itertools
 import threading
+import time
 from functools import partial
 from typing import Callable, Sequence
 
@@ -257,6 +258,30 @@ class Request:
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event)
     finish_reason: str | None = None  # "eos" | "length" | "error: ..."
+    # request-level latency accounting (host wall clock, perf_counter):
+    # submit_time set at submit(); one emit_times entry per token, set by
+    # the scheduler at the host moment the token is surfaced. TTFT =
+    # emit_times[0] - submit_time; inter-token latencies = diffs. Tokens
+    # committed in one multi-token dispatch share one host moment —
+    # near-zero ITLs inside a burst are real (burst delivery), the tail
+    # percentiles are where scheduling stalls show.
+    submit_time: float | None = None
+    emit_times: list[float] = dataclasses.field(default_factory=list)
+
+    def latency_stats(self) -> dict | None:
+        """TTFT and inter-token-latency summary (seconds); None until
+        two tokens have been emitted."""
+        if self.submit_time is None or len(self.emit_times) < 2:
+            return None
+        itl = [b - a for a, b in zip(self.emit_times, self.emit_times[1:])]
+        itl.sort()
+
+        def pct(p):
+            return itl[min(len(itl) - 1, int(p * len(itl)))]
+
+        return {"ttft": self.emit_times[0] - self.submit_time,
+                "itl_p50": pct(0.50), "itl_p99": pct(0.99),
+                "itl_max": itl[-1]}
 
     def result(self, timeout: float | None = None) -> list[int]:
         if not self._done.wait(timeout):
@@ -402,7 +427,7 @@ class InferenceServer:
                 f"prompt of {len(prompt)} tokens leaves no room to decode "
                 f"within max_len={self.max_len}")
         req = Request(prompt=list(prompt), max_new_tokens=max_new,
-                      stream=stream)
+                      stream=stream, submit_time=time.perf_counter())
         with self._lock:
             self._pending.append(req)
         return req
@@ -428,6 +453,7 @@ class InferenceServer:
             req.finish_reason = "eos"
             return True
         req.tokens.append(token)
+        req.emit_times.append(time.perf_counter())
         self.tokens_emitted += 1
         if logprob is not None:
             # append before stream(): a consumer woken by the stream

@@ -150,7 +150,10 @@ def test_serve_http_cli_paged(tmp_path):
              "num_heads": 4, "num_kv_heads": 2, "head_dim": 8,
              "mlp_dim": 64, "max_seq_len": 64, "dtype": "float32",
              "param_dtype": "float32", "remat": "none"}
+    draft = dict(model, embed_dim=16, num_layers=1, num_heads=2,
+                 num_kv_heads=2, mlp_dim=32)
     (tmp_path / "cfg.json").write_text(json.dumps({"model": model}))
+    (tmp_path / "draft.json").write_text(json.dumps({"model": draft}))
     env = dict(os.environ)
     # never let the subprocess dial the TPU relay (sitecustomize does on
     # import when this var is set; concurrent relay sessions wedge it)
@@ -159,8 +162,10 @@ def test_serve_http_cli_paged(tmp_path):
     proc = subprocess.Popen(
         [sys.executable, "-m", "cloud_server_tpu.generate",
          "--config", str(tmp_path / "cfg.json"),
-         "--serve-http", "0", "--spec-drafts", "2", "--page-size", "8",
-         "--max-slots", "2"],
+         "--serve-http", "0", "--page-size", "8", "--max-slots", "2",
+         # in-server DRAFT-MODEL speculation through the real CLI
+         "--draft-config", str(tmp_path / "draft.json"),
+         "--num-draft", "2"],
         env=env, stderr=subprocess.PIPE, text=True)
     try:
         import queue

@@ -40,8 +40,10 @@ def _engine_reference(params, prompt, n_new, cfg=CFG):
 PROMPTS = [[5, 9, 3], [17, 2, 40, 8, 21], [60], list(range(1, 14))]
 
 
-def test_paged_server_matches_engine_greedy(params):
-    srv = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+@pytest.mark.parametrize("allocation", ["ondemand", "reserve"])
+def test_paged_server_matches_engine_greedy(params, allocation):
+    srv = PagedInferenceServer(params, CFG, GREEDY, allocation=allocation,
+                               **SRV_KW)
     outs = srv.generate(PROMPTS, max_new_tokens=8)
     for prompt, out in zip(PROMPTS, outs):
         assert out == _engine_reference(params, prompt, 8), prompt
@@ -144,6 +146,52 @@ def test_speculative_actually_accepts(params):
     assert rate > 1.3, (srv.decode_tokens_committed, srv.decode_rounds)
 
 
+def _draft_setup():
+    draft_cfg = dataclasses.replace(CFG, embed_dim=16, num_layers=1,
+                                    num_heads=2, num_kv_heads=2, mlp_dim=32)
+    draft_params = transformer.init_params(draft_cfg, jax.random.key(9))
+    return draft_params, draft_cfg
+
+
+def test_draft_model_spec_greedy_parity(params):
+    """In-server DRAFT-MODEL speculation (classic speculative decoding
+    through the paged server) is token-for-token exact at temperature 0,
+    including across prefix-cache reuse (shared pages carry the draft
+    model's kv alongside the target's)."""
+    draft_params, draft_cfg = _draft_setup()
+    srv = PagedInferenceServer(params, CFG, GREEDY, spec_drafts=2,
+                               draft_params=draft_params,
+                               draft_cfg=draft_cfg, **SRV_KW)
+    prompts = [[3, 4, 5, 6] * 4, PROMPTS[0], PROMPTS[3]]
+    outs = srv.generate(prompts, max_new_tokens=10)
+    for prompt, out in zip(prompts, outs):
+        assert out == _engine_reference(params, prompt, 10), prompt
+    # a second request sharing a prefix reuses pages in BOTH pools
+    hits0 = srv.allocator.prefix_hit_pages
+    out2 = srv.generate([prompts[0] + [9]], max_new_tokens=10)[0]
+    assert srv.allocator.prefix_hit_pages > hits0
+    assert out2 == _engine_reference(params, prompts[0] + [9], 10)
+
+
+def test_draft_vocab_mismatch_fails_at_construction(params):
+    draft_params, draft_cfg = _draft_setup()
+    bad = dataclasses.replace(draft_cfg, vocab_size=CFG.vocab_size + 8)
+    with pytest.raises(ValueError, match="vocab_size"):
+        PagedInferenceServer(params, CFG, GREEDY, spec_drafts=2,
+                             draft_params=draft_params, draft_cfg=bad,
+                             **SRV_KW)
+
+
+def test_draft_model_spec_sampled_smoke(params):
+    draft_params, draft_cfg = _draft_setup()
+    icfg = dataclasses.replace(GREEDY, temperature=0.9, top_k=20)
+    srv = PagedInferenceServer(params, CFG, icfg, spec_drafts=2,
+                               draft_params=draft_params,
+                               draft_cfg=draft_cfg, **SRV_KW)
+    outs = srv.generate(PROMPTS[:2], max_new_tokens=9)
+    assert all(len(o) == 9 for o in outs)
+
+
 def test_speculative_sampled_distribution_smoke(params):
     """Stochastic spec decoding runs end-to-end and respects budgets."""
     icfg = dataclasses.replace(GREEDY, temperature=0.8, top_k=20)
@@ -207,6 +255,19 @@ def test_oversized_request_fails_cleanly(params):
         r.result(timeout=1)
 
 
+def test_latency_stats_recorded(params):
+    """Every request carries submit/emit wall-clock times; TTFT and ITL
+    percentiles come out of latency_stats()."""
+    srv = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    r = srv.submit(PROMPTS[0], max_new_tokens=8)
+    srv.run_until_idle()
+    st = r.latency_stats()
+    assert st is not None
+    assert st["ttft"] > 0
+    assert st["itl_max"] >= st["itl_p99"] >= st["itl_p50"] >= 0
+    assert len(r.emit_times) == len(r.tokens)
+
+
 def test_pallas_wide_prefill_chunks(params):
     """decode_attention_impl='pallas' with a prefill chunk wider than the
     narrow kernel's cap routes the wide (grid) kernel for admission
@@ -268,6 +329,64 @@ def test_lora_merged_paged_matches_engine():
         assert out == _engine_reference(merged, prompt, 8), prompt
     base_srv = PagedInferenceServer(base, CFG, GREEDY, **SRV_KW)
     assert base_srv.generate(PROMPTS[:2], max_new_tokens=8) != outs
+
+
+def test_ondemand_concurrency_beyond_reservation(params):
+    """On-demand allocation admits every request where full reservation
+    serializes them, preempting (youngest-first, radix-cached requeue)
+    when chains outgrow the pool — outputs stay exact throughout."""
+    prompts = [[(i * 9 + k) % 60 + 1 for k in range(8)] for i in range(6)]
+    kw = dict(max_slots=6, max_context=64, page_size=8, prefill_chunk=16,
+              prompt_buckets=[16], num_pages=12, decode_chunk=2)
+
+    # full reservation: each request reserves ceil((8+40+1)/8) = 7 of 12
+    # pages -> one slot in flight at a time
+    rsv = PagedInferenceServer(params, CFG, GREEDY, allocation="reserve",
+                               **kw)
+    for p in prompts:
+        rsv.submit(p, max_new_tokens=40)
+    rsv.step()
+    assert rsv.num_active == 1
+
+    # on-demand: all 6 admit concurrently on 2 pages each
+    srv = PagedInferenceServer(params, CFG, GREEDY, allocation="ondemand",
+                               **kw)
+    reqs = [srv.submit(p, max_new_tokens=40) for p in prompts]
+    srv.step()
+    assert srv.num_active == 6
+    srv.run_until_idle()
+    assert srv.preemptions > 0  # chains outgrew the pool mid-decode
+    for p, r in zip(prompts, reqs):
+        assert r.result() == _engine_reference(params, p, 40), p
+
+
+def test_ondemand_preemption_with_speculation(params):
+    """Preemption + continuation under the speculative decode loop."""
+    prompts = [[3, 4, 5, 6] * 2 for _ in range(4)]
+    srv = PagedInferenceServer(params, CFG, GREEDY, allocation="ondemand",
+                               spec_drafts=2, max_slots=4, max_context=64,
+                               page_size=8, prefill_chunk=16,
+                               prompt_buckets=[16], num_pages=10,
+                               decode_chunk=2)
+    reqs = [srv.submit(p, max_new_tokens=30) for p in prompts]
+    srv.run_until_idle()
+    want = _engine_reference(params, prompts[0], 30)
+    for r in reqs:
+        assert r.result() == want
+
+
+def test_ondemand_single_oversized_fails_cleanly(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY, allocation="ondemand",
+                               max_slots=2, max_context=64, page_size=8,
+                               num_pages=3, prefill_chunk=8,
+                               prompt_buckets=[16])
+    r = srv.submit([1, 2, 3], max_new_tokens=40)  # needs 6 of 3 pages
+    srv.run_until_idle()
+    assert r.finish_reason.startswith("error")
+    with pytest.raises(RuntimeError):
+        r.result(timeout=1)
+    # pool accounting stays consistent after the failure
+    assert srv.allocator.available == 3
 
 
 def test_eviction_under_churn(params):
