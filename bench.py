@@ -33,6 +33,20 @@ Config notes (measured on TPU v5e, this repo):
     paged kernel's value is block-table indirection + length-bounded
     reads (ragged contexts) at near-roofline, not a speedup at XLA's
     best shape.
+  * r4 MFU sweep (benchmarks/mfu_sweep.py, matmul_roofline.py) — all
+    measured LOSERS at the unchanged 330M config, baseline 215.9 ms:
+    vocab_chunk 4096/8192 -> 223.7/221.6 ms (reconfirms r2);
+    scan_layers_unroll 2/4 -> 240.9/254.0 ms; remat="attn" -> 226.5 ms;
+    remat="none" CRASHES the remote tpu_compile_helper (HTTP 500, exit
+    1) with flash AND with xla attention — the policy most likely to
+    cut the backward is environment-blocked, not flash-specific.
+    Roofline context: the model's own matmul shapes sustain 193-236
+    TF/s in isolation (within ~15% of wide-matmul rates on this chip),
+    so the plateau is inter-matmul overhead (attention kernel, norms,
+    saved-activation traffic, scheduling), not matmul geometry —
+    without a profiler through the tunnel (still blocked), the
+    remaining levers are hand-fused pallas (qkv+rope+write, CE) whose
+    plausible wins are single-digit ms each.
 """
 
 from __future__ import annotations
@@ -327,8 +341,11 @@ def _admission_churn_bench(params, base, infer_cfg):
     cfg = dataclasses.replace(base, decode_attention_impl="pallas")
 
     def scenario():
+        # max_slots leaves headroom beyond the initial decode batch so a
+        # wave admission lands MID-DECODE (the thing TTFT measures here)
+        # instead of queueing for a free slot
         srv = PagedInferenceServer(
-            params, cfg, infer_cfg, max_slots=8, max_context=1024,
+            params, cfg, infer_cfg, max_slots=16, max_context=1024,
             page_size=128, prefill_chunk=256, decode_chunk=8,
             prompt_buckets=[64, 256, 512])
         rng = np.random.RandomState(0)
@@ -336,7 +353,7 @@ def _admission_churn_bench(params, base, infer_cfg):
         def mk_prompt(n):
             return [int(x) for x in rng.randint(1, 30000, size=n)]
 
-        first = [srv.submit(mk_prompt(64), max_new_tokens=64)
+        first = [srv.submit(mk_prompt(64), max_new_tokens=256)
                  for _ in range(8)]
         for _ in range(2):
             srv.step()
@@ -345,7 +362,7 @@ def _admission_churn_bench(params, base, infer_cfg):
         waves = []
         # three waves of long-prompt arrivals while the first batch decodes
         for _ in range(3):
-            waves += [srv.submit(mk_prompt(400), max_new_tokens=32)
+            waves += [srv.submit(mk_prompt(400), max_new_tokens=128)
                       for _ in range(4)]
             for _ in range(6):
                 admitting = bool(srv._jobs) or srv.num_pending > 0

@@ -18,7 +18,15 @@ per compile).
 """
 
 import itertools
+import os
+import sys
 import time
+
+# run as `python benchmarks/mfu_sweep.py` from the repo root — fix
+# sys.path here rather than via PYTHONPATH (which interferes with the
+# axon PJRT plugin registration on this box)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

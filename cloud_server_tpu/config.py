@@ -69,6 +69,13 @@ class ModelConfig:
     # rematerialisation policy for the layer scan:
     # "none" | "full" | "dots" | "attn" (save only flash-attention residuals)
     remat: str = "full"
+    # lax.scan unroll factor for the layer stack (1 = no unrolling).
+    # Unrolling lets XLA fuse/overlap across layer boundaries at the
+    # cost of a proportionally larger program; measured v5e r4 sweep at
+    # the 330M bench config it LOSES outright (215.9 ms at 1, 240.9 at
+    # 2, 254.0 at 4 — bigger programs schedule worse here). Kept as a
+    # knob because the tradeoff is model/chip dependent.
+    scan_layers_unroll: int = 1
     logits_softcap: float = 0.0
     # Training-loss vocab chunk size. 0 = dense path (materialise the full
     # (B, S, V) f32 logits). >0 = fused blockwise CE: the unembed matmul,

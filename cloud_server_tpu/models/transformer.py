@@ -297,7 +297,8 @@ def forward_hidden(params: Params, tokens: jnp.ndarray,
     def scan_body(carry, layer_params):
         return block(carry, layer_params), None
 
-    x, _ = lax.scan(scan_body, x, params["layers"])
+    x, _ = lax.scan(scan_body, x, params["layers"],
+                    unroll=cfg.scan_layers_unroll)
     x = constrain(x, ("batch", "sequence", None))
     return rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
 

@@ -173,8 +173,11 @@ def test_serve_http_cli_paged(tmp_path):
         lines: queue.Queue = queue.Queue()
 
         def _pump():
-            for ln in proc.stderr:
-                lines.put(ln)
+            try:
+                for ln in proc.stderr:
+                    lines.put(ln)
+            except ValueError:
+                pass  # stderr closed when the server is killed
             lines.put(None)
 
         threading.Thread(target=_pump, daemon=True).start()
