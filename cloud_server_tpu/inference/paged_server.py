@@ -70,9 +70,11 @@ from cloud_server_tpu.config import InferConfig, ModelConfig
 from cloud_server_tpu.inference import paged_engine
 from cloud_server_tpu.inference.block_allocator import BlockAllocator
 from cloud_server_tpu.inference.sampling import (
-    sample_from_probs, sample_logits, sampling_probs)
+    SamplingParams, SamplingRows, make_rows, sample_from_probs,
+    sample_logits, sample_logits_rows, sampling_probs,
+    sampling_probs_rows)
 from cloud_server_tpu.inference.server import (
-    Request, _bucket, _token_logprobs)
+    Request, _bucket, _token_logprobs, emit_token, resolve_seed)
 from cloud_server_tpu.inference.speculative import (
     _accept_drafts, _accept_point_mass, _ngram_drafts)
 
@@ -113,13 +115,15 @@ def _split_cache(cache):
 
 @partial(jax.jit,
          static_argnames=("cfg", "infer_cfg", "scatter_prompt", "mesh",
-                          "draft_cfg"),
+                          "draft_cfg", "use_rows"),
          donate_argnums=(1,))
 def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
                    slot_ids, prompt_rows, prompt_lens, rng,
+                   samp_rows, orig_lens, count_mask,
                    draft_params=None, *,
                    cfg: ModelConfig, infer_cfg: InferConfig,
-                   scatter_prompt: bool, mesh=None, draft_cfg=None):
+                   scatter_prompt: bool, mesh=None, draft_cfg=None,
+                   use_rows: bool = False):
     """One admission chunk for a (padded) G-row group.
 
     chunk: (G, Wc) tokens for positions [g_lens, g_lens + Wc) per row —
@@ -131,15 +135,53 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
     slot's device history for n-gram drafting. Padding rows carry
     slot_id == max_slots and sentinel tables: every scatter drops.
 
+    Per-request sampling state: `orig_lens` (G,) marks the original
+    prompt / generated boundary inside `prompt_rows` (continuations from
+    a preemption carry already-generated tokens, which must count as
+    OUTPUT for presence/frequency penalties); `count_mask` (G,) flags
+    the chunk where each row's first-token sample is truly captured.
+    `samp_rows` always lands in the slots' row state; `use_rows`
+    (static) additionally samples the first token through it.
+
     Returns (state', first-token candidates (G,), their logprobs (G,)).
     """
     cache = _make_cache(state["pools"], g_lens, g_tables)
     logits, cache = paged_engine.window_forward(
         params, chunk, cfg, cache, logits_at=sample_at, mesh=mesh)
-    toks = sample_logits(logits, rng, infer_cfg)
-    lps = _token_logprobs(logits, toks)
     new_state = dict(state)
     new_state["pools"] = _split_cache(cache)
+
+    pm, oc = state["prompt_mask"], state["out_counts"]
+    g, pb = prompt_rows.shape
+    vsz = pm.shape[-1]
+    rowi = jnp.arange(g)
+    if scatter_prompt:
+        # rebuild the slots' penalty state from the admission prompt:
+        # positions < orig_len are PROMPT presence, [orig_len, prompt_len)
+        # are generated-before-preemption OUTPUT counts
+        pos = jnp.broadcast_to(jnp.arange(pb)[None, :], (g, pb))
+        pm_cols = jnp.where(pos < orig_lens[:, None], prompt_rows, vsz)
+        pm_rows = jnp.zeros((g, vsz), bool).at[
+            rowi[:, None], pm_cols].set(True, mode="drop")
+        oc_cols = jnp.where((pos >= orig_lens[:, None])
+                            & (pos < prompt_lens[:, None]),
+                            prompt_rows, vsz)
+        oc_rows = jnp.zeros((g, vsz), jnp.int32).at[
+            rowi[:, None], oc_cols].add(1, mode="drop")
+        pm = pm.at[slot_ids].set(pm_rows, mode="drop")
+        oc = oc.at[slot_ids].set(oc_rows, mode="drop")
+    if use_rows:
+        toks = sample_logits_rows(logits, samp_rows, prompt_lens,
+                                  prompt_mask=pm[slot_ids],
+                                  out_counts=oc[slot_ids])
+    else:
+        toks = sample_logits(logits, rng, infer_cfg)
+    lps = _token_logprobs(logits, toks)
+    # the captured first token is this slot's first generated token
+    oc = oc.at[slot_ids, toks].add(count_mask.astype(jnp.int32),
+                                   mode="drop")
+    new_state["prompt_mask"] = pm
+    new_state["out_counts"] = oc
     if draft_cfg is not None:
         # the draft model prefills the same chunk into ITS pools (same
         # page ids / tables, draft geometry) so in-server draft-model
@@ -162,24 +204,29 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "infer_cfg", "n_rounds", "mesh"),
+         static_argnames=("cfg", "infer_cfg", "n_rounds", "mesh",
+                          "use_rows"),
          donate_argnums=(1,))
 def _decode_rounds(params, state, lengths, tables, last_token, live,
-                   rng, *, cfg: ModelConfig, infer_cfg: InferConfig,
-                   n_rounds: int, mesh=None):
+                   rng, samp_rows, *, cfg: ModelConfig,
+                   infer_cfg: InferConfig, n_rounds: int, mesh=None,
+                   use_rows: bool = False):
     """n_rounds plain decode steps (W=1) in one dispatch (lax.scan).
 
     `live` slots advance one token per round; the rest are frozen (their
     writes drop through the sentinel tables the caller passes).
+    `use_rows` (static) samples through the per-request SamplingRows,
+    advancing the generated-token counts for penalties.
 
     Returns (state', lengths', last', (toks (R, B), lps (R, B),
     counts (R, B) int32)).
     """
     pad = infer_cfg.pad_token_id
     batch_idx = jnp.arange(lengths.shape[0])
+    pm = state["prompt_mask"]
 
     def body(carry, rng_t):
-        lengths, last, hist, pools = carry
+        lengths, last, hist, pools, oc = carry
         # `last` is the committed token at sequence position `lengths`
         # (this round writes its kv there); record it in the history so
         # drafting/multi-turn reads see an unbroken token sequence
@@ -189,31 +236,42 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
         logits, cache = paged_engine.window_forward(
             params, last[:, None], cfg, cache,
             logits_at=jnp.zeros_like(lengths), mesh=mesh)
-        tok = sample_logits(logits, rng_t, infer_cfg)
+        if use_rows:
+            # the sampled token sits at position lengths + 1 (`last`
+            # occupies `lengths`); the admission chunk folds the prompt
+            # length, so positions never collide within a request
+            tok = sample_logits_rows(logits, samp_rows, lengths + 1,
+                                     prompt_mask=pm, out_counts=oc)
+            oc = oc.at[batch_idx, tok].add(live.astype(jnp.int32))
+        else:
+            tok = sample_logits(logits, rng_t, infer_cfg)
         lp = _token_logprobs(logits, tok)
         tok = jnp.where(live, tok, pad)
         new_len = jnp.where(live, lengths + 1, lengths)
         last = jnp.where(live, tok, last)
-        return ((new_len, last, hist, _split_cache(cache)),
+        return ((new_len, last, hist, _split_cache(cache), oc),
                 (tok, lp, live.astype(jnp.int32)))
 
-    (lengths, last, hist, pools), out = lax.scan(
-        body, (lengths, last_token, state["hist"], state["pools"]),
+    (lengths, last, hist, pools, oc), out = lax.scan(
+        body, (lengths, last_token, state["hist"], state["pools"],
+               state["out_counts"]),
         jax.random.split(rng, n_rounds))
     new_state = dict(state)
     new_state["pools"] = pools
     new_state["hist"] = hist
+    new_state["out_counts"] = oc
     return new_state, lengths, last, out
 
 
 @partial(jax.jit,
          static_argnames=("cfg", "infer_cfg", "n_rounds", "n_drafts",
-                          "mesh", "draft_cfg"),
+                          "mesh", "draft_cfg", "use_rows"),
          donate_argnums=(1,))
 def _spec_rounds(params, state, lengths, tables, last_token, live,
-                 stop_len, rng, draft_params=None, *, cfg: ModelConfig,
-                 infer_cfg: InferConfig, n_rounds: int, n_drafts: int,
-                 mesh=None, draft_cfg=None):
+                 stop_len, rng, samp_rows, draft_params=None, *,
+                 cfg: ModelConfig, infer_cfg: InferConfig, n_rounds: int,
+                 n_drafts: int, mesh=None, draft_cfg=None,
+                 use_rows: bool = False):
     """n_rounds speculative rounds in one dispatch.
 
     Each round drafts `n_drafts` tokens per slot — from a DRAFT MODEL
@@ -233,6 +291,13 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
     are masked by lengths and overwritten by later rounds, exactly like
     the target pool.
 
+    Per-request sampling (`use_rows`): penalties stay EXACT through the
+    window — target probabilities at window position i use the counts as
+    of that position (base counts + the drafts committed before i, a
+    shifted cumulative one-hot), and the draft model's q at step j uses
+    the same construction, so the accept rule compares the identical
+    distributions plain per-token decoding would have sampled from.
+
     Returns (state', lengths', last',
     (toks (R, B, G+1), lps (R, B, G+1), counts (R, B))).
     """
@@ -242,9 +307,10 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
     batch_idx = jnp.arange(b)
     j = jnp.arange(g + 1)[None, :]
     use_draft = draft_cfg is not None
+    pm = state["prompt_mask"]
 
     def body(carry, rng_t):
-        lengths, last, hist, pools, dpools = carry
+        lengths, last, hist, pools, dpools, oc = carry
         rng_acc, rng_draft = jax.random.split(rng_t)
         can_commit = live & (lengths < stop_len)
 
@@ -256,12 +322,17 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
         valid = lengths + 1  # committed tokens = [0, lengths] incl. last
         if use_draft:
             def d_step(dc, inp):
-                tok, off, rng_d = inp
+                tok, off, rng_d, cnt = inp
                 dcache = _make_cache(dc, lengths + off, tables)
                 dlogits, dcache = paged_engine.window_forward(
                     draft_params, tok[:, None], draft_cfg, dcache,
                     logits_at=jnp.zeros_like(lengths), mesh=mesh)
-                qp = sampling_probs(dlogits, infer_cfg)
+                if use_rows:
+                    qp = sampling_probs_rows(dlogits, samp_rows,
+                                             prompt_mask=pm,
+                                             out_counts=cnt)
+                else:
+                    qp = sampling_probs(dlogits, infer_cfg)
                 nxt = sample_from_probs(qp, rng_d)
                 return _split_cache(dcache), (nxt, qp)
 
@@ -271,10 +342,14 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
             # iteration outputs, so unroll manually (G is tiny/static)
             toks_j, qps = [], []
             tok = last
+            run_cnt = oc  # counts as of each draft position (exactness)
             for step in range(g + 1):
                 rng_draft, rd = jax.random.split(rng_draft)
-                dpools, (tok, qp) = d_step(
-                    dpools, (tok, jnp.int32(step), rd))
+                dpools, (nxt, qp) = d_step(
+                    dpools, (tok, jnp.int32(step), rd, run_cnt))
+                if use_rows and step < g:
+                    run_cnt = run_cnt.at[batch_idx, nxt].add(1)
+                tok = nxt
                 toks_j.append(tok)
                 qps.append(qp)
             drafts = jnp.stack(toks_j[:g], axis=1)        # (B, G)
@@ -288,7 +363,20 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
         vlogits, cache = paged_engine.window_forward(
             params, window, cfg, cache, logits_at=None, all_logits=True,
             mesh=mesh)
-        p_probs = sampling_probs(vlogits, infer_cfg)  # (B, G+1, V)
+        if use_rows:
+            # counts at window position i = base + drafts committed
+            # before i (position 0 scores the token after `last`, which
+            # is already in the base counts)
+            cum = jnp.cumsum(
+                jax.nn.one_hot(drafts, vlogits.shape[-1],
+                               dtype=jnp.int32), axis=1)
+            counts_w = oc[:, None, :] + jnp.concatenate(
+                [jnp.zeros_like(cum[:, :1]), cum], axis=1)
+            p_probs = sampling_probs_rows(vlogits, samp_rows,
+                                          prompt_mask=pm,
+                                          out_counts=counts_w)
+        else:
+            p_probs = sampling_probs(vlogits, infer_cfg)  # (B, G+1, V)
         if use_draft:
             n_acc, x = _accept_drafts(drafts, q_probs, p_probs, rng_acc)
         else:
@@ -313,18 +401,23 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
         cols = (lengths + 1)[:, None] + j
         cols = jnp.where(j < count[:, None], cols, hist.shape[1])
         hist = hist.at[batch_idx[:, None], cols].set(toks, mode="drop")
+        if use_rows:
+            vsz = oc.shape[-1]
+            cnt_cols = jnp.where(j < count[:, None], toks, vsz)
+            oc = oc.at[batch_idx[:, None], cnt_cols].add(1, mode="drop")
         last_idx = jnp.maximum(count - 1, 0)
         last2 = jnp.where(count > 0, committed[batch_idx, last_idx], last)
-        return ((new_len, last2, hist, _split_cache(cache), dpools),
+        return ((new_len, last2, hist, _split_cache(cache), dpools, oc),
                 (toks, lps, count))
 
-    (lengths, last, hist, pools, dpools), out = lax.scan(
+    (lengths, last, hist, pools, dpools, oc), out = lax.scan(
         body, (lengths, last_token, state["hist"], state["pools"],
-               state.get("draft_pools")),
+               state.get("draft_pools"), state["out_counts"]),
         jax.random.split(rng, n_rounds))
     new_state = dict(state)
     new_state["pools"] = pools
     new_state["hist"] = hist
+    new_state["out_counts"] = oc
     if dpools is not None:
         new_state["draft_pools"] = dpools
     return new_state, lengths, last, out
@@ -472,6 +565,12 @@ class PagedInferenceServer:
         self.state = {
             "pools": _split_cache(cache),
             "hist": jnp.zeros((max_slots, max_context), jnp.int32),
+            # per-request sampling penalty state: prompt-token presence
+            # and generated-token counts per slot (advanced only by
+            # rows-mode dispatches — see sampling.SamplingRows)
+            "prompt_mask": jnp.zeros((max_slots, cfg.vocab_size), bool),
+            "out_counts": jnp.zeros((max_slots, cfg.vocab_size),
+                                    jnp.int32),
         }
         if draft_cfg is not None:
             dcache = paged_engine.init_paged_cache(
@@ -509,6 +608,13 @@ class PagedInferenceServer:
         self.active = np.zeros((max_slots,), bool)
         self.last_token = np.zeros((max_slots,), np.int32)
         self.stop_len = np.zeros((max_slots,), np.int32)
+        # per-slot sampling parameter rows (numpy, set at admission) and
+        # which slots actually need the device rows path
+        self.samp_rows = make_rows([None] * max_slots, infer_cfg,
+                                   [0] * max_slots)
+        self._needs_rows = np.zeros((max_slots,), bool)
+        self.orig_len = np.zeros((max_slots,), np.int32)
+        self._host_rng = np.random.default_rng(seed)
 
         # Page-allocation policy:
         #   "ondemand" (default) — admission reserves only the prompt +
@@ -547,7 +653,8 @@ class PagedInferenceServer:
     # -- client API ---------------------------------------------------------
 
     def submit(self, prompt: Sequence[int], *,
-               max_new_tokens: int | None = None, stream=None) -> Request:
+               max_new_tokens: int | None = None, stream=None,
+               sampling: SamplingParams | None = None) -> Request:
         if self._stop.is_set():
             raise RuntimeError("server is stopped; not accepting requests")
         if len(prompt) == 0:
@@ -562,7 +669,10 @@ class PagedInferenceServer:
                 f"prompt of {len(prompt)} tokens leaves no room to decode "
                 f"within max_context={self.max_context}")
         req = Request(prompt=list(prompt), max_new_tokens=max_new,
-                      stream=stream, submit_time=time.perf_counter())
+                      stream=stream, sampling=sampling,
+                      seed_used=resolve_seed(sampling, self._host_rng,
+                                             self._lock),
+                      submit_time=time.perf_counter())
         with self._lock:
             self._pending.append(req)
         return req
@@ -592,19 +702,10 @@ class PagedInferenceServer:
         return sub
 
     def _emit(self, req: Request, token: int, logprob: float) -> bool:
-        if token == self.infer_cfg.eos_token_id:
-            req.finish_reason = "eos"
-            return True
-        req.tokens.append(token)
-        req.emit_times.append(time.perf_counter())
-        self.tokens_emitted += 1
-        req.logprobs.append(float(logprob))
-        if req.stream is not None:
-            req.stream(token)
-        if len(req.tokens) >= req.max_new_tokens:
-            req.finish_reason = "length"
-            return True
-        return False
+        done = emit_token(req, token, logprob, self.infer_cfg)
+        if not (done and req.finish_reason == "eos"):
+            self.tokens_emitted += 1  # stop-truncated tokens still count
+        return done
 
     def _committed(self, slot_id: int) -> list[int]:
         """The slot's committed token stream, truncated to the device's
@@ -632,6 +733,7 @@ class PagedInferenceServer:
         self.tables[slot_id, :] = self.allocator.num_pages  # sentinel
         self.active[slot_id] = False
         self.lengths[slot_id] = 0
+        self._needs_rows[slot_id] = False  # don't pin rows-mode dispatch
         return slot
 
     def _finish(self, slot_id: int) -> None:
@@ -695,6 +797,16 @@ class PagedInferenceServer:
                 self.lengths[slot_id] = shared_len
                 self.stop_len[slot_id] = slot.stop_len
                 self.active[slot_id] = False  # live once admission is done
+                # per-request sampling rows (seed stable across
+                # preemption: seed_used was fixed at submit)
+                row = make_rows([req.sampling], self.infer_cfg,
+                                [req.seed_used])
+                for dst, src in zip(self.samp_rows, row):
+                    dst[slot_id] = src[0]
+                self._needs_rows[slot_id] = (
+                    req.sampling is not None
+                    and req.sampling.needs_device_rows(self.infer_cfg))
+                self.orig_len[slot_id] = len(req.prompt)
                 staged.append(slot_id)
         if not staged:
             return
@@ -756,16 +868,29 @@ class PagedInferenceServer:
             (job.rem_lens - 1) < (c + 1) * w)
         prompt_rows = pad_rows(job.prompt_rows, self.infer_cfg.pad_token_id)
         prompt_lens = pad_rows(job.prompt_lens, 0)
+        sl = np.asarray(job.slots)
+        # padding rows get NEUTRAL values (temp 0 = greedy, rep/top_p 1):
+        # their samples are discarded, but rep=0 would divide to inf/NaN
+        # and trip jax_debug_nans even on discarded rows
+        _fills = (0.0, 0, 1.0, 0.0, 1.0, 0.0, 0.0, 0)
+        samp_g = SamplingRows(*[pad_rows(dst[sl], fill)
+                                for dst, fill in zip(self.samp_rows,
+                                                     _fills)])
+        orig_lens = pad_rows(self.orig_len[sl], 0)
+        count_mask = pad_rows(in_range, False)
+        use_rows = bool(self._needs_rows[sl].any())
 
         self.state, toks, lps = _prefill_chunk(
             self.params, self.state, jnp.asarray(chunk),
             jnp.asarray(g_lens, jnp.int32), jnp.asarray(g_tables),
             jnp.asarray(sample_at, jnp.int32), jnp.asarray(slot_ids),
             jnp.asarray(prompt_rows), jnp.asarray(prompt_lens, jnp.int32),
-            self._next_rng(), self.draft_params,
+            self._next_rng(), jax.tree.map(jnp.asarray, samp_g),
+            jnp.asarray(orig_lens, jnp.int32), jnp.asarray(count_mask),
+            self.draft_params,
             cfg=self.cfg, infer_cfg=self.infer_cfg,
             scatter_prompt=(c == 0), mesh=self.mesh,
-            draft_cfg=self.draft_cfg)
+            draft_cfg=self.draft_cfg, use_rows=use_rows)
         toks, lps = jax.device_get((toks, lps))
         toks, lps = np.asarray(toks)[:g], np.asarray(lps)[:g]
         job.toks = np.where(in_range, toks, job.toks)
@@ -894,21 +1019,23 @@ class PagedInferenceServer:
                                  self.allocator.num_pages)
         args = (jnp.asarray(self.lengths), jnp.asarray(masked_tables),
                 jnp.asarray(self.last_token), jnp.asarray(live))
+        samp = jax.tree.map(jnp.asarray, self.samp_rows)
+        use_rows = bool((self._needs_rows & live).any())
         if self.spec_drafts > 0:
             self.state, lens, last, (toks, lps, counts) = _spec_rounds(
                 self.params, self.state, *args,
-                jnp.asarray(self.stop_len), self._next_rng(),
+                jnp.asarray(self.stop_len), self._next_rng(), samp,
                 self.draft_params,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
                 n_drafts=self.spec_drafts, mesh=self.mesh,
-                draft_cfg=self.draft_cfg)
+                draft_cfg=self.draft_cfg, use_rows=use_rows)
             toks, lps, counts, lens, last = jax.device_get(
                 (toks, lps, counts, lens, last))
         else:
             self.state, lens, last, (toks, lps, counts) = _decode_rounds(
-                self.params, self.state, *args, self._next_rng(),
+                self.params, self.state, *args, self._next_rng(), samp,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
-                mesh=self.mesh)
+                mesh=self.mesh, use_rows=use_rows)
             toks, lps, counts, lens, last = jax.device_get(
                 (toks, lps, counts, lens, last))
             toks, lps = toks[:, :, None], lps[:, :, None]

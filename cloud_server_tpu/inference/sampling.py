@@ -1,18 +1,51 @@
 """Token sampling — fully jittable (static shapes, no host sync).
 
-top-k uses lax.top_k; top-p sorts once and masks the tail. Both reduce to
-greedy when disabled. Temperature 0 is treated as greedy.
+Two tiers share one filter chain (penalties -> temperature -> top-k ->
+top-p -> min-p):
+
+  * Server-global (`InferConfig` scalars, compiled in as statics):
+    `sample_logits` / `sampling_probs`. The historical path — zero
+    per-step overhead when every request uses the server defaults.
+  * Per-request (`SamplingParams` -> `SamplingRows`, traced (B,) row
+    arrays): `sample_logits_rows` / `sampling_probs_rows`. Each slot of
+    the continuous batch carries its own temperature/top-k/top-p/min-p,
+    repetition/presence/frequency penalties, and PRNG seed. The rows are
+    tiny traced inputs, so mixing requests with different settings never
+    recompiles; the servers only take this path when some live request
+    actually needs it (static `use_rows` flag — the default-greedy hot
+    loop pays nothing).
+
+Per-request determinism: a seeded request's stream is reproducible
+regardless of batch composition, because its draw at sequence position p
+uses `fold_in(key(seed), p)` — no cross-slot RNG coupling. (With
+in-server speculative decoding the OUTPUT DISTRIBUTION is preserved but
+bitwise reproducibility is not: accept/residual draws are batch-wide.)
+
+top-k uses a descending sort shared with top-p's cumulative mass scan;
+both reduce to greedy when disabled. Temperature <= 0 is treated as
+greedy (per row in the rows path). Penalties follow the OpenAI/vLLM
+conventions: presence/frequency count GENERATED tokens only,
+repetition_penalty (HF-style) spans prompt and generated tokens.
 """
 
 from __future__ import annotations
 
+import dataclasses
+from typing import NamedTuple, Sequence
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from cloud_server_tpu.config import InferConfig
 
 NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Server-global path (InferConfig statics)
+# ---------------------------------------------------------------------------
 
 
 def _apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
@@ -74,3 +107,225 @@ def sample_from_probs(probs: jnp.ndarray, rng: jax.Array) -> jnp.ndarray:
     """Categorical draw from (..., V) probabilities -> (...,) int32."""
     return jax.random.categorical(
         rng, jnp.log(jnp.maximum(probs, 1e-30)), axis=-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Per-request path (SamplingParams -> SamplingRows)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling controls (every field optional; `None` and
+    the neutral defaults inherit the server's `InferConfig`).
+
+    `stop` holds TOKEN-ID sequences (text front-ends tokenize string
+    stops before submit): generation ends with finish_reason "stop" the
+    moment the output's tail equals one of them, and the matched tokens
+    are removed
+    from the result (OpenAI semantics). Tokens of a partially-matched
+    stop sequence may already have been streamed by the time the match
+    completes; the final token list is authoritative.
+
+    `seed` makes the request's stream reproducible independent of batch
+    composition (see module docstring for the speculative caveat).
+    """
+
+    temperature: float | None = None
+    top_k: int | None = None
+    top_p: float | None = None
+    min_p: float = 0.0
+    repetition_penalty: float = 1.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    seed: int | None = None
+    stop: tuple[tuple[int, ...], ...] = ()
+    ignore_eos: bool = False
+
+    def __post_init__(self):
+        if self.temperature is not None and self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        if not 0.0 <= self.min_p < 1.0:
+            raise ValueError("min_p must be in [0, 1)")
+        if self.repetition_penalty <= 0.0:
+            raise ValueError("repetition_penalty must be > 0")
+        # normalise stop to hashable tuples (callers may pass lists)
+        stop = tuple(tuple(int(t) for t in s) for s in self.stop)
+        if any(len(s) == 0 for s in stop):
+            raise ValueError("empty stop sequence")
+        object.__setattr__(self, "stop", stop)
+
+    def needs_device_rows(self, cfg: InferConfig) -> bool:
+        """True when this request's DEVICE-side sampling differs from the
+        server defaults (stop/ignore_eos are host-side and free)."""
+        return ((self.temperature is not None
+                 and self.temperature != cfg.temperature)
+                or (self.top_k is not None and self.top_k != cfg.top_k)
+                or (self.top_p is not None and self.top_p != cfg.top_p)
+                or self.min_p > 0.0
+                or self.repetition_penalty != 1.0
+                or self.presence_penalty != 0.0
+                or self.frequency_penalty != 0.0
+                or self.seed is not None)
+
+    def resolve(self, cfg: InferConfig, default_seed: int) -> tuple:
+        """Concrete (temperature, top_k, top_p, min_p, rep, pres, freq,
+        seed) row values with `None` fields inherited from `cfg`."""
+        return (
+            cfg.temperature if self.temperature is None else self.temperature,
+            cfg.top_k if self.top_k is None else self.top_k,
+            cfg.top_p if self.top_p is None else self.top_p,
+            self.min_p, self.repetition_penalty, self.presence_penalty,
+            self.frequency_penalty,
+            default_seed if self.seed is None else self.seed)
+
+
+class SamplingRows(NamedTuple):
+    """Per-slot sampling parameters as device rows (a pytree of (B,)
+    arrays — traced jit inputs, never statics)."""
+
+    temperature: jnp.ndarray  # (B,) f32; <= 0 means greedy for that row
+    top_k: jnp.ndarray        # (B,) i32; <= 0 disables
+    top_p: jnp.ndarray        # (B,) f32
+    min_p: jnp.ndarray        # (B,) f32
+    rep: jnp.ndarray          # (B,) f32 repetition penalty (1 = off)
+    pres: jnp.ndarray         # (B,) f32 presence penalty
+    freq: jnp.ndarray         # (B,) f32 frequency penalty
+    seed: jnp.ndarray         # (B,) uint32 per-request PRNG seed
+
+
+def make_rows(params_list: Sequence[SamplingParams | None],
+              cfg: InferConfig,
+              default_seeds: Sequence[int]) -> SamplingRows:
+    """Host-side builder: one numpy row per request (jnp.asarray at the
+    dispatch boundary)."""
+    vals = [(p or SamplingParams()).resolve(cfg, int(s))
+            for p, s in zip(params_list, default_seeds)]
+    t, k, p, mp, rep, pres, freq, seed = zip(*vals)
+    return SamplingRows(
+        temperature=np.asarray(t, np.float32),
+        top_k=np.asarray(k, np.int32),
+        top_p=np.asarray(p, np.float32),
+        min_p=np.asarray(mp, np.float32),
+        rep=np.asarray(rep, np.float32),
+        pres=np.asarray(pres, np.float32),
+        freq=np.asarray(freq, np.float32),
+        seed=np.asarray(np.asarray(seed, np.int64) & 0xFFFFFFFF, np.uint32))
+
+
+def zero_rows(n: int) -> SamplingRows:
+    """All-zero rows (temperature 0 = greedy) — initial state for slots
+    nothing has been admitted into."""
+    return SamplingRows(
+        temperature=jnp.zeros((n,), jnp.float32),
+        top_k=jnp.zeros((n,), jnp.int32),
+        top_p=jnp.ones((n,), jnp.float32),
+        min_p=jnp.zeros((n,), jnp.float32),
+        rep=jnp.ones((n,), jnp.float32),
+        pres=jnp.zeros((n,), jnp.float32),
+        freq=jnp.zeros((n,), jnp.float32),
+        seed=jnp.zeros((n,), jnp.uint32))
+
+
+def set_rows(state: SamplingRows, slots: jnp.ndarray,
+             rows: SamplingRows) -> SamplingRows:
+    """Scatter admission rows into per-slot row state (out-of-range slot
+    indices drop — the padding convention of the admission dispatches)."""
+    return SamplingRows(*[
+        s.at[slots].set(r.astype(s.dtype), mode="drop")
+        for s, r in zip(state, rows)])
+
+
+def _expand(row: jnp.ndarray, ref: jnp.ndarray) -> jnp.ndarray:
+    """(B,) -> (B, 1, ..., 1) matching ref's rank for broadcasting."""
+    return row.reshape(row.shape[0], *([1] * (ref.ndim - 1)))
+
+
+def penalised_logits(logits: jnp.ndarray, rows: SamplingRows,
+                     prompt_mask: jnp.ndarray,
+                     out_counts: jnp.ndarray) -> jnp.ndarray:
+    """Presence/frequency penalties over generated-token counts
+    (`out_counts`, broadcastable to `logits`) and HF-style repetition
+    penalty over prompt-or-generated (`prompt_mask` (B, V) bool)."""
+    x = logits.astype(jnp.float32)
+    counts = out_counts.astype(jnp.float32)
+    pm = prompt_mask if prompt_mask.ndim == x.ndim else prompt_mask[:, None]
+    x = (x - _expand(rows.pres, x) * (counts > 0)
+         - _expand(rows.freq, x) * counts)
+    seen = pm | (out_counts > 0)
+    rep = _expand(rows.rep, x)
+    return jnp.where(seen, jnp.where(x > 0, x / rep, x * rep), x)
+
+
+def filtered_logits_rows(logits: jnp.ndarray, rows: SamplingRows, *,
+                         prompt_mask: jnp.ndarray | None = None,
+                         out_counts: jnp.ndarray | None = None):
+    """Per-row filter chain over (B, ..., V) logits.
+
+    Returns (filtered logits for categorical draws, post-penalty
+    pre-temperature logits — the greedy-row argmax source)."""
+    x = logits.astype(jnp.float32)
+    if prompt_mask is not None:
+        x = penalised_logits(x, rows, prompt_mask, out_counts)
+    raw = x
+    xt = x / jnp.maximum(_expand(rows.temperature, x), 1e-6)
+    v = x.shape[-1]
+    k = _expand(jnp.where(rows.top_k <= 0, v, rows.top_k), x)
+    xs = jnp.sort(xt, axis=-1)[..., ::-1]
+    ps = jax.nn.softmax(xs, axis=-1)
+    cum = jnp.cumsum(ps, axis=-1)
+    rank = jnp.arange(v)
+    keep = (rank < k) & ((cum - ps) < _expand(rows.top_p, x))
+    keep = keep.at[..., 0].set(True)  # never mask everything
+    cutoff = jnp.min(jnp.where(keep, xs, jnp.inf), axis=-1, keepdims=True)
+    mask = xt >= cutoff
+    # min-p: relative to the max probability of the temperature-scaled
+    # distribution; the argmax always survives (p_max >= min_p * p_max)
+    probs = jax.nn.softmax(xt, axis=-1)
+    mask &= probs >= _expand(rows.min_p, x) * jnp.max(ps, axis=-1,
+                                                      keepdims=True)
+    return jnp.where(mask, xt, NEG_INF), raw
+
+
+def _row_keys(rows: SamplingRows, positions: jnp.ndarray) -> jax.Array:
+    """One key per row: fold the absolute sequence position into the
+    request's seed key — draws depend only on (seed, position), never on
+    which other requests share the batch."""
+    def mk(seed, pos):
+        return jax.random.fold_in(jax.random.key(seed), pos)
+
+    return jax.vmap(mk)(rows.seed, positions)
+
+
+def sample_logits_rows(logits: jnp.ndarray, rows: SamplingRows,
+                       positions: jnp.ndarray, *,
+                       prompt_mask: jnp.ndarray | None = None,
+                       out_counts: jnp.ndarray | None = None
+                       ) -> jnp.ndarray:
+    """Per-row draw: (B, V) logits -> (B,) int32. `positions` (B,) is the
+    absolute sequence position being sampled (the fold_in counter)."""
+    filt, raw = filtered_logits_rows(logits, rows, prompt_mask=prompt_mask,
+                                     out_counts=out_counts)
+    keys = _row_keys(rows, positions)
+    sampled = jax.vmap(jax.random.categorical)(keys, filt)
+    greedy = jnp.argmax(raw, axis=-1)
+    return jnp.where(rows.temperature <= 0.0, greedy,
+                     sampled).astype(jnp.int32)
+
+
+def sampling_probs_rows(logits: jnp.ndarray, rows: SamplingRows, *,
+                        prompt_mask: jnp.ndarray | None = None,
+                        out_counts: jnp.ndarray | None = None
+                        ) -> jnp.ndarray:
+    """Rows analogue of `sampling_probs`: the exact per-row distribution
+    `sample_logits_rows` draws from, over (B, ..., V) logits (speculative
+    verification scores whole windows — pass cumulative `out_counts`
+    matching the window so penalties stay exact position by position)."""
+    filt, raw = filtered_logits_rows(logits, rows, prompt_mask=prompt_mask,
+                                     out_counts=out_counts)
+    probs = jax.nn.softmax(filt, axis=-1)
+    onehot = jax.nn.one_hot(jnp.argmax(raw, axis=-1), logits.shape[-1],
+                            dtype=probs.dtype)
+    return jnp.where(_expand(rows.temperature <= 0.0, probs), onehot, probs)

@@ -50,7 +50,9 @@ from jax import lax
 
 from cloud_server_tpu.config import InferConfig, ModelConfig
 from cloud_server_tpu.inference import engine
-from cloud_server_tpu.inference.sampling import sample_logits
+from cloud_server_tpu.inference.sampling import (
+    SamplingParams, SamplingRows, make_rows, sample_logits,
+    sample_logits_rows, set_rows, zero_rows)
 
 
 def _token_logprobs(logits: jnp.ndarray, toks: jnp.ndarray) -> jnp.ndarray:
@@ -64,7 +66,8 @@ class SlotState:
     """Device-resident server state (a pytree)."""
 
     def __init__(self, k, v, length, last_token, active,
-                 k_scale=None, v_scale=None):
+                 k_scale=None, v_scale=None, samp=None,
+                 prompt_mask=None, out_counts=None):
         self.k = k                    # (L, B, max_len, KH, Dh)
         self.v = v
         self.length = length          # (B,) int32
@@ -72,10 +75,20 @@ class SlotState:
         self.active = active          # (B,) bool
         self.k_scale = k_scale        # int8 kv cache only, else None
         self.v_scale = v_scale
+        # per-request sampling state: parameter rows, prompt-token
+        # presence (B, V) bool and generated-token counts (B, V) int32
+        # for penalties. Rows are written by every admission; the count
+        # buffers advance only in rows-mode decode dispatches (they only
+        # influence penalty-enabled requests, whose lifetime forces rows
+        # mode — see step()).
+        self.samp = samp              # SamplingRows of (B,) arrays
+        self.prompt_mask = prompt_mask
+        self.out_counts = out_counts
 
     def tree_flatten(self):
         return (self.k, self.v, self.length, self.last_token,
-                self.active, self.k_scale, self.v_scale), None
+                self.active, self.k_scale, self.v_scale, self.samp,
+                self.prompt_mask, self.out_counts), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -93,13 +106,47 @@ def init_slot_state(cfg: ModelConfig, max_slots: int,
         k=cache.k, v=cache.v, length=cache.length,
         last_token=jnp.zeros((max_slots,), jnp.int32),
         active=jnp.zeros((max_slots,), bool),
-        k_scale=cache.k_scale, v_scale=cache.v_scale)
+        k_scale=cache.k_scale, v_scale=cache.v_scale,
+        samp=zero_rows(max_slots),
+        prompt_mask=jnp.zeros((max_slots, cfg.vocab_size), bool),
+        out_counts=jnp.zeros((max_slots, cfg.vocab_size), jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
+def _prompt_presence(token_rows: jnp.ndarray, true_lens: jnp.ndarray,
+                     vocab: int) -> jnp.ndarray:
+    """(G, Pb) token rows + true lengths -> (G, vocab) bool presence."""
+    g, pb = token_rows.shape
+    rowi = jnp.arange(g)
+    valid = jnp.arange(pb)[None, :] < true_lens[:, None]
+    cols = jnp.where(valid, token_rows, vocab)
+    return jnp.zeros((g, vocab), bool).at[rowi[:, None], cols].set(
+        True, mode="drop")
+
+
+def _admit_sampling_state(state: SlotState, samp_rows: SamplingRows,
+                          slots: jnp.ndarray, pm_rows: jnp.ndarray,
+                          first_toks: jnp.ndarray):
+    """Shared admission bookkeeping for per-request sampling: write the
+    group's parameter rows, the slots' prompt-presence masks (`pm_rows`,
+    from `_prompt_presence`), and reset generated-token counts to the
+    first sampled token. Always applied (cheap scatters) so a later
+    rows-mode decode sees correct state for slots admitted under the
+    static path.
+
+    Returns (samp, prompt_mask, out_counts)."""
+    g, v = pm_rows.shape
+    oc = jnp.zeros((g, v), jnp.int32).at[jnp.arange(g), first_toks].add(1)
+    return (set_rows(state.samp, slots, samp_rows),
+            state.prompt_mask.at[slots].set(pm_rows, mode="drop"),
+            state.out_counts.at[slots].set(oc, mode="drop"))
+
+
+@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "use_rows"),
+         donate_argnums=(1,))
 def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
                  true_lens: jnp.ndarray, slots: jnp.ndarray, rng: jax.Array,
-                 *, cfg: ModelConfig, infer_cfg: InferConfig):
+                 samp_rows: SamplingRows, *, cfg: ModelConfig,
+                 infer_cfg: InferConfig, use_rows: bool = False):
     """Prefill G prompts (G, Pb) into `slots` (G,); sample first tokens.
 
     A whole admission burst is ONE batched prefill (full MXU batch) instead
@@ -109,12 +156,24 @@ def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
     traced, so slot choice never recompiles; only (G, Pb) does (both are
     bucketed by the caller).
 
+    `use_rows` (static) switches first-token sampling to the per-request
+    SamplingRows path; the rows themselves are always recorded so later
+    rows-mode decodes see this group's parameters.
+
     Returns (state', first_tokens (G,), their logprobs (G,) f32).
     """
     g, pb = prompts.shape
     tmp = engine.init_cache(cfg, g, pb)
     logits, tmp = engine.prefill(params, prompts, cfg, tmp, true_lens)
-    toks = sample_logits(logits, rng, infer_cfg)  # (G,)
+    pm_g = _prompt_presence(prompts, true_lens, logits.shape[-1])
+    if use_rows:
+        # first generated token: no output counts yet
+        toks = sample_logits_rows(logits, samp_rows, true_lens,
+                                  prompt_mask=pm_g,
+                                  out_counts=jnp.zeros_like(logits,
+                                                            jnp.int32))
+    else:
+        toks = sample_logits(logits, rng, infer_cfg)  # (G,)
     lps = _token_logprobs(logits, toks)  # (G,)
 
     k = state.k.at[:, slots, :pb].set(tmp.k, mode="drop")
@@ -125,20 +184,25 @@ def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
                                                       mode="drop")
         v_scale = state.v_scale.at[:, slots, :pb].set(tmp.v_scale,
                                                       mode="drop")
+    samp, pmask, counts = _admit_sampling_state(
+        state, samp_rows, slots, pm_g, toks)
     return SlotState(
         k=k, v=v,
         length=state.length.at[slots].set(true_lens, mode="drop"),
         last_token=state.last_token.at[slots].set(toks, mode="drop"),
         active=state.active.at[slots].set(True, mode="drop"),
-        k_scale=k_scale, v_scale=v_scale), toks, lps
+        k_scale=k_scale, v_scale=v_scale, samp=samp, prompt_mask=pmask,
+        out_counts=counts), toks, lps
 
 
-@partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "use_rows"),
+         donate_argnums=(1,))
 def _admit_batch_prefixed(params, state: SlotState, prefix_kv,
                           remainders: jnp.ndarray,
                           true_lens: jnp.ndarray, slots: jnp.ndarray,
-                          rng: jax.Array, *, cfg: ModelConfig,
-                          infer_cfg: InferConfig):
+                          rng: jax.Array, samp_rows: SamplingRows,
+                          prefix_toks: jnp.ndarray, *, cfg: ModelConfig,
+                          infer_cfg: InferConfig, use_rows: bool = False):
     """Admission via a cached common-prefix KV (prefix caching).
 
     The prefix's cache entries (prefix_kv: dict with k/v (L, 1, P0, KH,
@@ -170,9 +234,20 @@ def _admit_batch_prefixed(params, state: SlotState, prefix_kv,
 
     logits, tmp = engine.verify_step(params, remainders, cfg, tmp)
     last = logits[jnp.arange(g), true_lens - 1]  # (G, V)
-    toks = sample_logits(last, rng, infer_cfg)
-    lps = _token_logprobs(last, toks)
     new_lens = p0 + true_lens
+    # the slot's true prompt is prefix + remainder: build the padded
+    # full-prompt rows once and share them with the sampling-state scatter
+    full_rows = jnp.concatenate(
+        [jnp.broadcast_to(prefix_toks[None, :], (g, p0)), remainders],
+        axis=1)
+    pm_g = _prompt_presence(full_rows, new_lens, last.shape[-1])
+    if use_rows:
+        toks = sample_logits_rows(last, samp_rows, new_lens,
+                                  prompt_mask=pm_g,
+                                  out_counts=jnp.zeros_like(last, jnp.int32))
+    else:
+        toks = sample_logits(last, rng, infer_cfg)
+    lps = _token_logprobs(last, toks)
 
     width = p0 + rb
     k = state.k.at[:, slots, :width].set(tmp.k, mode="drop")
@@ -183,41 +258,61 @@ def _admit_batch_prefixed(params, state: SlotState, prefix_kv,
                                                          mode="drop")
         v_scale = state.v_scale.at[:, slots, :width].set(tmp.v_scale,
                                                          mode="drop")
+    samp, pmask, counts = _admit_sampling_state(
+        state, samp_rows, slots, pm_g, toks)
     return SlotState(
         k=k, v=v,
         length=state.length.at[slots].set(new_lens, mode="drop"),
         last_token=state.last_token.at[slots].set(toks, mode="drop"),
         active=state.active.at[slots].set(True, mode="drop"),
-        k_scale=k_scale, v_scale=v_scale), toks, lps
+        k_scale=k_scale, v_scale=v_scale, samp=samp, prompt_mask=pmask,
+        out_counts=counts), toks, lps
 
 
 def _decode_core(params, state: SlotState, rng: jax.Array,
-                 cfg: ModelConfig, infer_cfg: InferConfig):
+                 cfg: ModelConfig, infer_cfg: InferConfig,
+                 use_rows: bool = False):
     """One decode step over all slots; inactive slots are frozen."""
     cache = engine.KVCache(state.k, state.v, state.length,
                            state.k_scale, state.v_scale)
     logits, cache = engine.decode_step(params, state.last_token, cfg, cache)
-    tok = sample_logits(logits, rng, infer_cfg)
+    out_counts = state.out_counts
+    if use_rows:
+        # the sampled token sits at absolute position length + 1 (`last`
+        # occupies `length`); admission folds the prompt length for the
+        # first token, so positions never collide within a request
+        tok = sample_logits_rows(logits, state.samp, state.length + 1,
+                                 prompt_mask=state.prompt_mask,
+                                 out_counts=out_counts)
+        out_counts = out_counts.at[
+            jnp.arange(tok.shape[0]), tok].add(state.active.astype(jnp.int32))
+    else:
+        tok = sample_logits(logits, rng, infer_cfg)
     lp = _token_logprobs(logits, tok)
     tok = jnp.where(state.active, tok, infer_cfg.pad_token_id)
     length = jnp.where(state.active, cache.length, state.length)
     return SlotState(k=cache.k, v=cache.v, length=length, last_token=tok,
                      active=state.active, k_scale=cache.k_scale,
-                     v_scale=cache.v_scale), (tok, lp)
+                     v_scale=cache.v_scale, samp=state.samp,
+                     prompt_mask=state.prompt_mask,
+                     out_counts=out_counts), (tok, lp)
 
 
-@partial(jax.jit, static_argnames=("cfg", "infer_cfg"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "use_rows"),
+         donate_argnums=(1,))
 def _decode(params, state: SlotState, rng: jax.Array, *, cfg: ModelConfig,
-            infer_cfg: InferConfig):
+            infer_cfg: InferConfig, use_rows: bool = False):
     """Returns (state', (tokens (B,) int32, logprobs (B,) f32)) with pad
     in inactive rows."""
-    return _decode_core(params, state, rng, cfg, infer_cfg)
+    return _decode_core(params, state, rng, cfg, infer_cfg, use_rows)
 
 
-@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "n_steps"),
+@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "n_steps",
+                                   "use_rows"),
          donate_argnums=(1,))
 def _decode_chunk(params, state: SlotState, rng: jax.Array, *,
-                  cfg: ModelConfig, infer_cfg: InferConfig, n_steps: int):
+                  cfg: ModelConfig, infer_cfg: InferConfig, n_steps: int,
+                  use_rows: bool = False):
     """n_steps decode steps in ONE dispatch (lax.scan on device).
 
     Multi-token scheduling: the host syncs (device_get of the sampled
@@ -231,7 +326,7 @@ def _decode_chunk(params, state: SlotState, rng: jax.Array, *,
     logprobs (n_steps, B) f32)).
     """
     def body(st, r):
-        return _decode_core(params, st, r, cfg, infer_cfg)
+        return _decode_core(params, st, r, cfg, infer_cfg, use_rows)
 
     return lax.scan(body, state, jax.random.split(rng, n_steps))
 
@@ -241,7 +336,9 @@ def _deactivate(state: SlotState, slot: jnp.ndarray) -> SlotState:
     return SlotState(k=state.k, v=state.v, length=state.length,
                      last_token=state.last_token,
                      active=state.active.at[slot].set(False),
-                     k_scale=state.k_scale, v_scale=state.v_scale)
+                     k_scale=state.k_scale, v_scale=state.v_scale,
+                     samp=state.samp, prompt_mask=state.prompt_mask,
+                     out_counts=state.out_counts)
 
 
 @dataclasses.dataclass
@@ -251,6 +348,14 @@ class Request:
     prompt: list[int]
     max_new_tokens: int
     stream: Callable[[int], None] | None = None
+    # per-request sampling controls (None = server defaults). Device-side
+    # fields ride into dispatches as SamplingRows; stop / ignore_eos are
+    # enforced host-side in emit_token.
+    sampling: SamplingParams | None = None
+    # the seed actually used for this request's device rows (the request's
+    # own, or one drawn from the server's host RNG at submit) — stable
+    # across preemption/re-admission
+    seed_used: int = 0
     tokens: list[int] = dataclasses.field(default_factory=list)
     # log P(token) under the model's raw (pre-filter) distribution,
     # aligned with `tokens`
@@ -293,6 +398,55 @@ class Request:
     @property
     def done(self) -> bool:
         return self._done.is_set()
+
+
+def resolve_seed(sampling: SamplingParams | None, host_rng, lock) -> int:
+    """The request's own seed, or a fresh draw from the server's host
+    RNG (under `lock`) — fixed once at submit so a preempted request
+    re-admits with the same rows. Shared by both servers."""
+    if sampling is not None and sampling.seed is not None:
+        return int(sampling.seed)
+    with lock:
+        return int(host_rng.integers(0, 2 ** 32))
+
+
+def emit_token(req: Request, token: int, logprob: float | None,
+               infer_cfg: InferConfig) -> bool:
+    """Record one generated token on `req`; True when the request just
+    finished (eos / stop sequence / length). The single emit rule both
+    servers share.
+
+    Stop sequences are token-level: when the output's tail equals one of
+    `req.sampling.stop`, the matched tokens are removed (OpenAI
+    semantics) and finish_reason is "stop". The final token of a match is
+    never streamed, but earlier tokens of the sequence were streamed as
+    they arrived — the final `tokens` list is authoritative."""
+    sp = req.sampling
+    if token == infer_cfg.eos_token_id and not (sp and sp.ignore_eos):
+        req.finish_reason = "eos"
+        return True
+    req.tokens.append(token)
+    req.emit_times.append(time.perf_counter())
+    if logprob is not None:
+        # append before stream(): a consumer woken by the stream
+        # callback may read logprobs[len(tokens)-1]
+        req.logprobs.append(float(logprob))
+    if sp and sp.stop:
+        for s in sp.stop:
+            ls = len(s)
+            if len(req.tokens) >= ls and req.tokens[-ls:] == list(s):
+                del req.tokens[-ls:]
+                del req.emit_times[-ls:]
+                if req.logprobs:
+                    del req.logprobs[-ls:]
+                req.finish_reason = "stop"
+                return True
+    if req.stream is not None:
+        req.stream(token)
+    if len(req.tokens) >= req.max_new_tokens:
+        req.finish_reason = "length"
+        return True
+    return False
 
 
 def _bucket(n: int, buckets: Sequence[int]) -> int:
@@ -403,6 +557,8 @@ class InferenceServer:
         # one thread a buffer the other just donated.
         self._step_lock = threading.Lock()
         self._rng = jax.random.key(seed)
+        # host RNG: default per-request seeds for unseeded requests
+        self._host_rng = np.random.default_rng(seed)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -410,7 +566,8 @@ class InferenceServer:
 
     def submit(self, prompt: Sequence[int], *,
                max_new_tokens: int | None = None,
-               stream: Callable[[int], None] | None = None) -> Request:
+               stream: Callable[[int], None] | None = None,
+               sampling: SamplingParams | None = None) -> Request:
         if self._stop.is_set():
             # stop() was called or serve_forever died on a fatal error —
             # accepting now would enqueue work nothing will ever drain and
@@ -427,7 +584,10 @@ class InferenceServer:
                 f"prompt of {len(prompt)} tokens leaves no room to decode "
                 f"within max_len={self.max_len}")
         req = Request(prompt=list(prompt), max_new_tokens=max_new,
-                      stream=stream, submit_time=time.perf_counter())
+                      stream=stream, sampling=sampling,
+                      seed_used=resolve_seed(sampling, self._host_rng,
+                                             self._lock),
+                      submit_time=time.perf_counter())
         with self._lock:
             self._pending.append(req)
         return req
@@ -449,22 +609,13 @@ class InferenceServer:
     def _emit(self, req: Request, token: int,
               logprob: float | None = None) -> bool:
         """Record one generated token; True if the request just finished."""
-        if token == self.infer_cfg.eos_token_id:
-            req.finish_reason = "eos"
-            return True
-        req.tokens.append(token)
-        req.emit_times.append(time.perf_counter())
-        self.tokens_emitted += 1
-        if logprob is not None:
-            # append before stream(): a consumer woken by the stream
-            # callback may read logprobs[len(tokens)-1]
-            req.logprobs.append(float(logprob))
-        if req.stream is not None:
-            req.stream(token)
-        if len(req.tokens) >= req.max_new_tokens:
-            req.finish_reason = "length"
-            return True
-        return False
+        done = emit_token(req, token, logprob, self.infer_cfg)
+        # count every token the model computed and the stream accepted —
+        # a stop-sequence match truncates the request's token list but
+        # those tokens were still generated (throughput accounting)
+        if not (done and req.finish_reason == "eos"):
+            self.tokens_emitted += 1
+        return done
 
     def _finish(self, slot: int, req: Request) -> None:
         self._slots[slot] = None
@@ -549,23 +700,51 @@ class InferenceServer:
             slots[i] = group[i][0]
         return rows, true_lens, slots
 
+    def _group_rows(self, group) -> tuple[SamplingRows, bool]:
+        """Padded SamplingRows for an admission burst + whether any
+        member needs the device rows path. Padding rows are zeros (their
+        slot index drops every scatter anyway)."""
+        gpad = 1
+        while gpad < len(group):
+            gpad *= 2
+        params_list = [req.sampling for _, req in group]
+        seeds = [req.seed_used for _, req in group]
+        params_list += [None] * (gpad - len(group))
+        seeds += [0] * (gpad - len(group))
+        rows = make_rows(params_list, self.infer_cfg, seeds)
+        use = any(sp is not None and sp.needs_device_rows(self.infer_cfg)
+                  for sp in params_list)
+        return rows, use
+
+    def _rows_mode(self) -> bool:
+        """True when any ACTIVE request needs per-request device
+        sampling — that request's whole lifetime then runs rows-mode
+        dispatches, which is what keeps its penalty counts advancing."""
+        return any(
+            r is not None and r.sampling is not None
+            and r.sampling.needs_device_rows(self.infer_cfg)
+            for r in self._slots)
+
     def _admit_group(self, group, token_rows, buckets, run_fn) -> None:
         """Shared burst plumbing: pad, dispatch one batched admission,
         emit first tokens."""
         rows, true_lens, slots = self._pad_group(group, token_rows,
                                                  buckets)
+        samp_rows, use_rows = self._group_rows(group)
         self.state, toks, lps = run_fn(
-            jnp.asarray(rows), jnp.asarray(true_lens), jnp.asarray(slots))
+            jnp.asarray(rows), jnp.asarray(true_lens), jnp.asarray(slots),
+            jax.tree.map(jnp.asarray, samp_rows), use_rows)
         toks, lps = jax.device_get((toks, lps))
         for i, (slot, req) in enumerate(group):
             if self._emit(req, int(toks[i]), float(lps[i])):
                 self._finish(slot, req)
 
     def _admit_group_plain(self, group) -> None:
-        def run(rows, tl, sl):
+        def run(rows, tl, sl, samp, use_rows):
             return _admit_batch(self.params, self.state, rows, tl, sl,
-                                self._next_rng(), cfg=self.cfg,
-                                infer_cfg=self.infer_cfg)
+                                self._next_rng(), samp, cfg=self.cfg,
+                                infer_cfg=self.infer_cfg,
+                                use_rows=use_rows)
 
         self._admit_group(group, [r.prompt for _, r in group],
                           self.prompt_buckets, run)
@@ -573,10 +752,12 @@ class InferenceServer:
     def _admit_group_prefixed(self, group) -> None:
         p0 = len(self._prefix)
 
-        def run(rows, tl, sl):
+        def run(rows, tl, sl, samp, use_rows):
             return _admit_batch_prefixed(
                 self.params, self.state, self._prefix_kv, rows, tl, sl,
-                self._next_rng(), cfg=self.cfg, infer_cfg=self.infer_cfg)
+                self._next_rng(), samp,
+                jnp.asarray(self._prefix, jnp.int32), cfg=self.cfg,
+                infer_cfg=self.infer_cfg, use_rows=use_rows)
 
         self._admit_group(group, [req.prompt[p0:] for _, req in group],
                           self._rem_buckets, run)
@@ -614,17 +795,20 @@ class InferenceServer:
             if self.num_active == 0:
                 return 0
             n = self._chunk_len()
+            use_rows = self._rows_mode()
             if n == 1:
                 self.state, out = _decode(
                     self.params, self.state, self._next_rng(),
-                    cfg=self.cfg, infer_cfg=self.infer_cfg)
+                    cfg=self.cfg, infer_cfg=self.infer_cfg,
+                    use_rows=use_rows)
                 toks, lps = jax.device_get(out)
                 chunk = np.asarray(toks)[None]       # (1, B)
                 lchunk = np.asarray(lps)[None]
             else:
                 self.state, out = _decode_chunk(
                     self.params, self.state, self._next_rng(),
-                    cfg=self.cfg, infer_cfg=self.infer_cfg, n_steps=n)
+                    cfg=self.cfg, infer_cfg=self.infer_cfg, n_steps=n,
+                    use_rows=use_rows)
                 toks, lps = jax.device_get(out)
                 chunk = np.asarray(toks)             # (n, B)
                 lchunk = np.asarray(lps)
