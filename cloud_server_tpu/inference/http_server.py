@@ -4,27 +4,51 @@ A thin stdlib (`http.server`) layer over the server `submit` API —
 `PagedInferenceServer` (the recommended backend: paged KV, radix prefix
 reuse, chunked prefill, in-server speculative decoding) or the legacy
 contiguous `InferenceServer`; both expose the same submit / num_active /
-num_pending surface. Prompts go in as JSON, tokens stream back as
-newline-delimited JSON the moment the scheduler emits them. No framework
-dependency — the serving hot path stays the jitted TPU program; this
-module only does sockets and JSON.
+num_pending surface. No framework dependency — the serving hot path
+stays the jitted TPU program; this module only does sockets and JSON.
 
-Protocol:
-  POST /generate    {"prompt": "text"} or {"tokens": [1, 2, 3]},
-                    optional "max_new_tokens". Response is
-                    `application/x-ndjson`: one {"token": id,
-                    "logprob": lp, "text": s}
-                    line per generated token (text only when a tokenizer is
-                    attached), then a final
-                    {"done": true, "finish_reason": ...,
-                    "tokens": [...], "logprobs": [...]} (logprobs aligned
-                    with tokens).
+Endpoints:
+
+  POST /generate    (native) {"prompt": "text"} or {"tokens": [...]},
+                    optional "max_new_tokens" and any per-request
+                    sampling field: temperature, top_k, top_p, min_p,
+                    repetition_penalty, presence_penalty,
+                    frequency_penalty, seed, ignore_eos, stop (a string,
+                    list of strings, or list of token-id lists).
+                    Response is `application/x-ndjson`: one
+                    {"token": id, "logprob": lp, "text": s} line per
+                    generated token (text only when a tokenizer is
+                    attached), then a final {"done": true,
+                    "finish_reason": ..., "tokens": [...],
+                    "logprobs": [...]}.
+  POST /v1/completions        OpenAI-compatible text completion:
+                    prompt (string, token list, or list of either),
+                    max_tokens, temperature, top_p, stop, seed, n,
+                    presence_penalty, frequency_penalty, logprobs,
+                    stream (SSE chunks, final `data: [DONE]`).
+  POST /v1/chat/completions   OpenAI-compatible chat: messages are
+                    rendered through the chat template (the attached
+                    tokenizer's own, when it has one, else a minimal
+                    role-tagged format); same sampling fields; stream
+                    sends `chat.completion.chunk` deltas.
+  GET  /v1/models   {"object": "list", "data": [{"id": ...}]}
   GET  /healthz     {"ok": true, "active": N, "pending": N}
 
-Demo (server side: `python -m cloud_server_tpu.generate --serve-http 8000
-...` or `HttpFrontend(srv, tok).start()`):
+Streaming text is emitted via incremental decode: each chunk is the
+SUFFIX the new tokens added to the decoded string, with a trailing
+partial UTF-8 sequence held back until complete (byte-level tokenizers
+emit multi-byte characters atomically).
 
-  curl -N -s localhost:8000/generate -d '{"prompt": "the meaning of"}'
+String `stop` entries are tokenized and enforced at token level
+(server-side emit rule); with BPE tokenizers a stop string that merges
+across a token boundary in the generation may not match — token-id
+stops are exact.
+
+Demo (server side: `python -m cloud_server_tpu.generate --serve-http
+8000 ...` or `HttpFrontend(srv, tok).start()`):
+
+  curl -N -s localhost:8000/v1/chat/completions \
+    -d '{"messages": [{"role": "user", "content": "hi"}], "stream": true}'
 
 Reference parity note: view-sonic/Cloud-Server @ v0 is an empty tree
 (SURVEY.md); this subsystem is part of the re-scoped build inventory
@@ -36,9 +60,121 @@ from __future__ import annotations
 import json
 import queue
 import threading
+import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from cloud_server_tpu.inference.sampling import SamplingParams
+
 _STREAM_END = object()
+
+# JSON body field -> SamplingParams field (shared by all POST endpoints;
+# OpenAI aliases are folded in by the endpoint parsers)
+_SAMPLING_FIELDS = ("temperature", "top_k", "top_p", "min_p",
+                    "repetition_penalty", "presence_penalty",
+                    "frequency_penalty", "seed", "ignore_eos")
+
+
+def _parse_stop(stop, tokenizer) -> tuple[tuple[int, ...], ...]:
+    """OpenAI `stop`: string | [strings] | [[token ids]] -> id tuples."""
+    if stop is None:
+        return ()
+    if isinstance(stop, str):
+        stop = [stop]
+    if not isinstance(stop, list):
+        raise ValueError('"stop" must be a string or a list')
+    out = []
+    for s in stop:
+        if isinstance(s, str):
+            if tokenizer is None:
+                raise ValueError(
+                    "string stop sequences need a tokenizer; send token-id "
+                    "lists instead")
+            ids = tokenizer.encode(s)
+            if ids:
+                out.append(tuple(ids))
+        elif (isinstance(s, list)
+              and all(isinstance(t, int) for t in s) and s):
+            out.append(tuple(s))
+        else:
+            raise ValueError('"stop" entries must be non-empty strings or '
+                             "token-id lists")
+    return tuple(out)
+
+
+def _parse_sampling(body: dict, tokenizer) -> SamplingParams | None:
+    """SamplingParams from a JSON body; None when every field is absent
+    (keeps the server's zero-overhead default path)."""
+    kw = {}
+    for f in _SAMPLING_FIELDS:
+        if body.get(f) is not None:
+            kw[f] = body[f]
+    stop = _parse_stop(body.get("stop"), tokenizer)
+    if stop:
+        kw["stop"] = stop
+    if not kw:
+        return None
+    try:
+        return SamplingParams(**kw)
+    except TypeError as exc:  # wrong field types surface as 400s
+        raise ValueError(str(exc)) from exc
+
+
+class _TextStream:
+    """Incremental decode: feed token ids, get the newly-stable text
+    suffix (holds back a trailing partial UTF-8 sequence)."""
+
+    def __init__(self, tokenizer):
+        self.tokenizer = tokenizer
+        self.ids: list[int] = []
+        self.sent = 0
+
+    def feed(self, ids) -> str:
+        if self.tokenizer is None:
+            return ""
+        self.ids.extend(ids)
+        text = self.tokenizer.decode(self.ids)
+        # hold back trailing replacement chars (partial multi-byte seq)
+        stable = len(text)
+        while stable > 0 and text[stable - 1] == "�":
+            stable -= 1
+        delta = text[self.sent:stable]
+        self.sent = stable
+        return delta
+
+    def flush(self) -> str:
+        if self.tokenizer is None:
+            return ""
+        text = self.tokenizer.decode(self.ids)
+        delta = text[self.sent:]
+        self.sent = len(text)
+        return delta
+
+
+def _render_chat(messages, tokenizer) -> str:
+    """Messages -> prompt text. Uses the tokenizer's own chat template
+    when it has one (HF fast tokenizers may); otherwise a minimal
+    role-tagged format that is stable across requests (so the radix
+    prefix cache hits on shared conversation heads)."""
+    tpl = getattr(tokenizer, "apply_chat_template", None)
+    if tpl is not None:
+        # transformers' apply_chat_template defaults to tokenize=True
+        # (returning ids); this function's contract is TEXT
+        return tpl(messages, add_generation_prompt=True, tokenize=False)
+    parts = []
+    for m in messages:
+        role = m.get("role", "user")
+        content = m.get("content", "")
+        if not isinstance(content, str):
+            raise ValueError("message content must be a string")
+        parts.append(f"<|{role}|>\n{content}\n")
+    parts.append("<|assistant|>\n")
+    return "".join(parts)
+
+
+def _finish(reason: str | None) -> str:
+    # OpenAI reports "stop" for natural ends (eos or a stop sequence)
+    return "length" if reason == "length" else "stop"
 
 
 class HttpFrontend:
@@ -50,9 +186,11 @@ class HttpFrontend:
     """
 
     def __init__(self, srv, tokenizer=None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 model_id: str = "cloud-server-tpu"):
         self.srv = srv
         self.tokenizer = tokenizer
+        self.model_id = model_id
         front = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -70,70 +208,49 @@ class HttpFrontend:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path != "/healthz":
+                if self.path == "/healthz":
+                    self._json(200, {"ok": True,
+                                     "active": front.srv.num_active,
+                                     "pending": front.srv.num_pending})
+                elif self.path == "/v1/models":
+                    self._json(200, {
+                        "object": "list",
+                        "data": [{"id": front.model_id, "object": "model",
+                                  "owned_by": "cloud-server-tpu"}]})
+                else:
                     self._json(404, {"error": "unknown path"})
-                    return
-                self._json(200, {"ok": True, "active": front.srv.num_active,
-                                 "pending": front.srv.num_pending})
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                return body
 
             def do_POST(self):
-                if self.path != "/generate":
+                routes = {"/generate": front._handle_generate,
+                          "/v1/completions": front._handle_completions,
+                          "/v1/chat/completions": front._handle_chat}
+                handler = routes.get(self.path)
+                if handler is None:
                     self._json(404, {"error": "unknown path"})
                     return
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n) or b"{}")
-                    if not isinstance(req, dict):
-                        raise ValueError("body must be a JSON object")
-                    max_new = req.get("max_new_tokens")
-                    if max_new is not None and not isinstance(max_new, int):
-                        raise ValueError('"max_new_tokens" must be an int')
-                    tokens = front._encode(req)
-                except (ValueError, KeyError, TypeError) as exc:
+                    body = self._body()
+                except (ValueError, json.JSONDecodeError) as exc:
                     self._json(400, {"error": str(exc)})
                     return
-
-                q: queue.Queue = queue.Queue()
                 try:
-                    request = front.srv.submit(
-                        tokens, max_new_tokens=max_new, stream=q.put)
+                    handler(self, body)
                 except ValueError as exc:
                     self._json(400, {"error": str(exc)})
-                    return
                 except RuntimeError as exc:  # scheduler stopped/crashed
                     self._json(503, {"error": str(exc)})
-                    return
-
-                self.send_response(200)
-                self.send_header("Content-Type", "application/x-ndjson")
-                self.send_header("Connection", "close")
-                self.end_headers()
-                threading.Thread(  # unblock q.get when generation ends
-                    target=lambda: (request._done.wait(),
-                                    q.put(_STREAM_END)),
-                    daemon=True).start()
-                emitted = 0
-                while True:
-                    tok = q.get()
-                    if tok is _STREAM_END:
-                        break
-                    line = {"token": int(tok)}
-                    # _emit appends the logprob before invoking the stream
-                    # callback, so it is present by the time we get here
-                    if emitted < len(request.logprobs):
-                        line["logprob"] = request.logprobs[emitted]
-                    emitted += 1
-                    if front.tokenizer is not None:
-                        line["text"] = front.tokenizer.decode([int(tok)])
-                    self.wfile.write((json.dumps(line) + "\n").encode())
-                    self.wfile.flush()
-                self.wfile.write((json.dumps(
-                    {"done": True, "finish_reason": request.finish_reason,
-                     "tokens": request.tokens,
-                     "logprobs": request.logprobs}) + "\n").encode())
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None
+
+    # -- shared plumbing ----------------------------------------------------
 
     def _encode(self, req: dict) -> list[int]:
         if "tokens" in req:
@@ -148,6 +265,229 @@ class HttpFrontend:
                     'no tokenizer attached; send {"tokens": [...]} instead')
             return self.tokenizer.encode(req["prompt"]) or [0]
         raise ValueError('body needs "prompt" or "tokens"')
+
+    def _submit_streaming(self, tokens, max_new, sampling):
+        """Submit with a queue-backed stream; returns (request, queue).
+        The queue yields token ids then _STREAM_END."""
+        q: queue.Queue = queue.Queue()
+        request = self.srv.submit(tokens, max_new_tokens=max_new,
+                                  stream=q.put, sampling=sampling)
+        threading.Thread(  # unblock q.get when generation ends
+            target=lambda: (request._done.wait(), q.put(_STREAM_END)),
+            daemon=True).start()
+        return request, q
+
+    @staticmethod
+    def _drain(q):
+        while True:
+            tok = q.get()
+            if tok is _STREAM_END:
+                return
+            yield int(tok)
+
+    # -- native endpoint ----------------------------------------------------
+
+    def _handle_generate(self, handler, body: dict) -> None:
+        max_new = body.get("max_new_tokens")
+        if max_new is not None and not isinstance(max_new, int):
+            raise ValueError('"max_new_tokens" must be an int')
+        tokens = self._encode(body)
+        sampling = _parse_sampling(body, self.tokenizer)
+        request, q = self._submit_streaming(tokens, max_new, sampling)
+
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+        emitted = 0
+        for tok in self._drain(q):
+            line = {"token": tok}
+            # _emit appends the logprob before invoking the stream
+            # callback, so it is present by the time we get here
+            if emitted < len(request.logprobs):
+                line["logprob"] = request.logprobs[emitted]
+            emitted += 1
+            if self.tokenizer is not None:
+                line["text"] = self.tokenizer.decode([tok])
+            handler.wfile.write((json.dumps(line) + "\n").encode())
+            handler.wfile.flush()
+        handler.wfile.write((json.dumps(
+            {"done": True, "finish_reason": request.finish_reason,
+             "tokens": request.tokens,
+             "logprobs": request.logprobs}) + "\n").encode())
+
+    # -- OpenAI-compatible endpoints ----------------------------------------
+
+    def _openai_sampling(self, body: dict):
+        """(max_tokens, SamplingParams) with OpenAI aliases folded in."""
+        max_new = body.get("max_tokens", body.get("max_new_tokens"))
+        if max_new is not None and not isinstance(max_new, int):
+            raise ValueError('"max_tokens" must be an int')
+        return max_new, _parse_sampling(body, self.tokenizer)
+
+    def _prompt_variants(self, body: dict) -> list[list[int]]:
+        """OpenAI `prompt`: string | token list | list of either."""
+        prompt = body.get("prompt")
+        if prompt is None:
+            raise ValueError('body needs "prompt"')
+        if isinstance(prompt, str):
+            prompts = [prompt]
+        elif isinstance(prompt, list) and prompt and all(
+                isinstance(t, int) for t in prompt):
+            prompts = [prompt]
+        elif isinstance(prompt, list) and prompt:
+            prompts = prompt
+        else:
+            raise ValueError('"prompt" must be a string, a token list, or '
+                             "a non-empty list of those")
+        out = []
+        for p in prompts:
+            if isinstance(p, str):
+                if self.tokenizer is None:
+                    raise ValueError("no tokenizer attached; send token "
+                                     "lists instead")
+                out.append(self.tokenizer.encode(p) or [0])
+            elif isinstance(p, list) and all(
+                    isinstance(t, int) for t in p):
+                out.append(p)
+            else:
+                raise ValueError('"prompt" entries must be strings or '
+                                 "token-id lists")
+        return out
+
+    def _sse_head(self, handler) -> None:
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+
+    @staticmethod
+    def _sse(handler, payload) -> None:
+        handler.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
+        handler.wfile.flush()
+
+    def _handle_completions(self, handler, body: dict) -> None:
+        max_new, sampling = self._openai_sampling(body)
+        prompts = self._prompt_variants(body)
+        n = body.get("n", 1)
+        if not isinstance(n, int) or n < 1:
+            raise ValueError('"n" must be a positive int')
+        want_logprobs = body.get("logprobs") is not None
+        rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        base = {"id": rid, "object": "text_completion", "created": created,
+                "model": body.get("model", self.model_id)}
+
+        if body.get("stream"):
+            if len(prompts) > 1 or n > 1:
+                raise ValueError("streaming supports a single prompt with "
+                                 "n=1")
+            request, q = self._submit_streaming(prompts[0], max_new,
+                                                sampling)
+            self._sse_head(handler)
+            stream = _TextStream(self.tokenizer)
+            for tok in self._drain(q):
+                delta = stream.feed([tok])
+                if delta:
+                    self._sse(handler, {
+                        **base,
+                        "choices": [{"text": delta, "index": 0,
+                                     "logprobs": None,
+                                     "finish_reason": None}]})
+            tail = stream.flush()
+            choice = {"text": tail, "index": 0, "logprobs": None,
+                      "finish_reason": _finish(request.finish_reason)}
+            self._sse(handler, {**base, "choices": [choice]})
+            handler.wfile.write(b"data: [DONE]\n\n")
+            handler.wfile.flush()
+            return
+
+        reqs = [self.srv.submit(p, max_new_tokens=max_new,
+                                sampling=sampling)
+                for p in prompts for _ in range(n)]
+        choices = []
+        usage_p = usage_c = 0
+        for i, r in enumerate(reqs):
+            toks = r.result()
+            usage_p += len(r.prompt)
+            usage_c += len(toks)
+            choice = {
+                "text": (self.tokenizer.decode(toks)
+                         if self.tokenizer is not None else ""),
+                "index": i, "logprobs": None,
+                "finish_reason": _finish(r.finish_reason)}
+            if want_logprobs:
+                choice["logprobs"] = {
+                    "tokens": [self.tokenizer.decode([t])
+                               if self.tokenizer is not None else str(t)
+                               for t in toks],
+                    "token_logprobs": r.logprobs,
+                    "top_logprobs": None, "text_offset": None}
+            if self.tokenizer is None:
+                choice["tokens"] = toks  # still useful without text
+            choices.append(choice)
+        handler._json(200, {
+            **base, "choices": choices,
+            "usage": {"prompt_tokens": usage_p,
+                      "completion_tokens": usage_c,
+                      "total_tokens": usage_p + usage_c}})
+
+    def _handle_chat(self, handler, body: dict) -> None:
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise ValueError('"messages" must be a non-empty list')
+        if self.tokenizer is None:
+            raise ValueError("chat completions need a tokenizer")
+        max_new, sampling = self._openai_sampling(body)
+        prompt = self.tokenizer.encode(
+            _render_chat(messages, self.tokenizer)) or [0]
+        rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        base = {"id": rid, "created": created,
+                "model": body.get("model", self.model_id)}
+
+        if body.get("stream"):
+            request, q = self._submit_streaming(prompt, max_new, sampling)
+            self._sse_head(handler)
+            self._sse(handler, {
+                **base, "object": "chat.completion.chunk",
+                "choices": [{"index": 0,
+                             "delta": {"role": "assistant"},
+                             "finish_reason": None}]})
+            stream = _TextStream(self.tokenizer)
+            for tok in self._drain(q):
+                delta = stream.feed([tok])
+                if delta:
+                    self._sse(handler, {
+                        **base, "object": "chat.completion.chunk",
+                        "choices": [{"index": 0,
+                                     "delta": {"content": delta},
+                                     "finish_reason": None}]})
+            tail = stream.flush()
+            delta = {"content": tail} if tail else {}
+            self._sse(handler, {
+                **base, "object": "chat.completion.chunk",
+                "choices": [{"index": 0, "delta": delta,
+                             "finish_reason":
+                                 _finish(request.finish_reason)}]})
+            handler.wfile.write(b"data: [DONE]\n\n")
+            handler.wfile.flush()
+            return
+
+        req = self.srv.submit(prompt, max_new_tokens=max_new,
+                              sampling=sampling)
+        toks = req.result()
+        handler._json(200, {
+            **base, "object": "chat.completion",
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant",
+                            "content": self.tokenizer.decode(toks)},
+                "finish_reason": _finish(req.finish_reason)}],
+            "usage": {"prompt_tokens": len(prompt),
+                      "completion_tokens": len(toks),
+                      "total_tokens": len(prompt) + len(toks)}})
 
     @property
     def address(self) -> tuple[str, int]:

@@ -151,37 +151,44 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
     new_state = dict(state)
     new_state["pools"] = _split_cache(cache)
 
-    pm, oc = state["prompt_mask"], state["out_counts"]
-    g, pb = prompt_rows.shape
-    vsz = pm.shape[-1]
-    rowi = jnp.arange(g)
-    if scatter_prompt:
-        # rebuild the slots' penalty state from the admission prompt:
-        # positions < orig_len are PROMPT presence, [orig_len, prompt_len)
-        # are generated-before-preemption OUTPUT counts
-        pos = jnp.broadcast_to(jnp.arange(pb)[None, :], (g, pb))
-        pm_cols = jnp.where(pos < orig_lens[:, None], prompt_rows, vsz)
-        pm_rows = jnp.zeros((g, vsz), bool).at[
-            rowi[:, None], pm_cols].set(True, mode="drop")
-        oc_cols = jnp.where((pos >= orig_lens[:, None])
-                            & (pos < prompt_lens[:, None]),
-                            prompt_rows, vsz)
-        oc_rows = jnp.zeros((g, vsz), jnp.int32).at[
-            rowi[:, None], oc_cols].add(1, mode="drop")
-        pm = pm.at[slot_ids].set(pm_rows, mode="drop")
-        oc = oc.at[slot_ids].set(oc_rows, mode="drop")
+    has_pen = "prompt_mask" in state  # buffers materialize lazily
+    pm = oc = None
+    if has_pen:
+        pm, oc = state["prompt_mask"], state["out_counts"]
+        g, pb = prompt_rows.shape
+        vsz = pm.shape[-1]
+        rowi = jnp.arange(g)
+        if scatter_prompt:
+            # rebuild the slots' penalty state from the admission
+            # prompt: positions < orig_len are PROMPT presence,
+            # [orig_len, prompt_len) are generated-before-preemption
+            # OUTPUT counts
+            pos = jnp.broadcast_to(jnp.arange(pb)[None, :], (g, pb))
+            pm_cols = jnp.where(pos < orig_lens[:, None], prompt_rows,
+                                vsz)
+            pm_rows = jnp.zeros((g, vsz), bool).at[
+                rowi[:, None], pm_cols].set(True, mode="drop")
+            oc_cols = jnp.where((pos >= orig_lens[:, None])
+                                & (pos < prompt_lens[:, None]),
+                                prompt_rows, vsz)
+            oc_rows = jnp.zeros((g, vsz), jnp.int32).at[
+                rowi[:, None], oc_cols].add(1, mode="drop")
+            pm = pm.at[slot_ids].set(pm_rows, mode="drop")
+            oc = oc.at[slot_ids].set(oc_rows, mode="drop")
     if use_rows:
-        toks = sample_logits_rows(logits, samp_rows, prompt_lens,
-                                  prompt_mask=pm[slot_ids],
-                                  out_counts=oc[slot_ids])
+        toks = sample_logits_rows(
+            logits, samp_rows, prompt_lens,
+            prompt_mask=pm[slot_ids] if has_pen else None,
+            out_counts=oc[slot_ids] if has_pen else None)
     else:
         toks = sample_logits(logits, rng, infer_cfg)
     lps = _token_logprobs(logits, toks)
-    # the captured first token is this slot's first generated token
-    oc = oc.at[slot_ids, toks].add(count_mask.astype(jnp.int32),
-                                   mode="drop")
-    new_state["prompt_mask"] = pm
-    new_state["out_counts"] = oc
+    if has_pen:
+        # the captured first token is this slot's first generated token
+        oc = oc.at[slot_ids, toks].add(count_mask.astype(jnp.int32),
+                                       mode="drop")
+        new_state["prompt_mask"] = pm
+        new_state["out_counts"] = oc
     if draft_cfg is not None:
         # the draft model prefills the same chunk into ITS pools (same
         # page ids / tables, draft geometry) so in-server draft-model
@@ -223,7 +230,7 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
     """
     pad = infer_cfg.pad_token_id
     batch_idx = jnp.arange(lengths.shape[0])
-    pm = state["prompt_mask"]
+    pm = state.get("prompt_mask")  # None until penalties materialize
 
     def body(carry, rng_t):
         lengths, last, hist, pools, oc = carry
@@ -242,7 +249,8 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
             # length, so positions never collide within a request
             tok = sample_logits_rows(logits, samp_rows, lengths + 1,
                                      prompt_mask=pm, out_counts=oc)
-            oc = oc.at[batch_idx, tok].add(live.astype(jnp.int32))
+            if oc is not None:
+                oc = oc.at[batch_idx, tok].add(live.astype(jnp.int32))
         else:
             tok = sample_logits(logits, rng_t, infer_cfg)
         lp = _token_logprobs(logits, tok)
@@ -254,12 +262,13 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
 
     (lengths, last, hist, pools, oc), out = lax.scan(
         body, (lengths, last_token, state["hist"], state["pools"],
-               state["out_counts"]),
+               state.get("out_counts")),
         jax.random.split(rng, n_rounds))
     new_state = dict(state)
     new_state["pools"] = pools
     new_state["hist"] = hist
-    new_state["out_counts"] = oc
+    if oc is not None:
+        new_state["out_counts"] = oc
     return new_state, lengths, last, out
 
 
@@ -307,7 +316,7 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
     batch_idx = jnp.arange(b)
     j = jnp.arange(g + 1)[None, :]
     use_draft = draft_cfg is not None
-    pm = state["prompt_mask"]
+    pm = state.get("prompt_mask")  # None until penalties materialize
 
     def body(carry, rng_t):
         lengths, last, hist, pools, dpools, oc = carry
@@ -347,7 +356,7 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
                 rng_draft, rd = jax.random.split(rng_draft)
                 dpools, (nxt, qp) = d_step(
                     dpools, (tok, jnp.int32(step), rd, run_cnt))
-                if use_rows and step < g:
+                if use_rows and run_cnt is not None and step < g:
                     run_cnt = run_cnt.at[batch_idx, nxt].add(1)
                 tok = nxt
                 toks_j.append(tok)
@@ -363,7 +372,7 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
         vlogits, cache = paged_engine.window_forward(
             params, window, cfg, cache, logits_at=None, all_logits=True,
             mesh=mesh)
-        if use_rows:
+        if use_rows and pm is not None:
             # counts at window position i = base + drafts committed
             # before i (position 0 scores the token after `last`, which
             # is already in the base counts)
@@ -375,6 +384,8 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
             p_probs = sampling_probs_rows(vlogits, samp_rows,
                                           prompt_mask=pm,
                                           out_counts=counts_w)
+        elif use_rows:
+            p_probs = sampling_probs_rows(vlogits, samp_rows)
         else:
             p_probs = sampling_probs(vlogits, infer_cfg)  # (B, G+1, V)
         if use_draft:
@@ -401,7 +412,7 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
         cols = (lengths + 1)[:, None] + j
         cols = jnp.where(j < count[:, None], cols, hist.shape[1])
         hist = hist.at[batch_idx[:, None], cols].set(toks, mode="drop")
-        if use_rows:
+        if use_rows and oc is not None:
             vsz = oc.shape[-1]
             cnt_cols = jnp.where(j < count[:, None], toks, vsz)
             oc = oc.at[batch_idx[:, None], cnt_cols].add(1, mode="drop")
@@ -412,12 +423,13 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
 
     (lengths, last, hist, pools, dpools, oc), out = lax.scan(
         body, (lengths, last_token, state["hist"], state["pools"],
-               state.get("draft_pools"), state["out_counts"]),
+               state.get("draft_pools"), state.get("out_counts")),
         jax.random.split(rng, n_rounds))
     new_state = dict(state)
     new_state["pools"] = pools
     new_state["hist"] = hist
-    new_state["out_counts"] = oc
+    if oc is not None:
+        new_state["out_counts"] = oc
     if dpools is not None:
         new_state["draft_pools"] = dpools
     return new_state, lengths, last, out
@@ -562,15 +574,14 @@ class PagedInferenceServer:
         cache = paged_engine.init_paged_cache(
             cfg, num_pages=num_pages, page_size=page_size, batch=max_slots,
             max_pages_per_slot=self.max_pages_per_slot)
+        # per-request sampling penalty state ("prompt_mask" /
+        # "out_counts", (B, V) per slot) is NOT allocated here — the
+        # first admission that needs penalties materializes it
+        # (_ensure_penalty_state), so penalty-free serving never pays
+        # its HBM or scatter cost
         self.state = {
             "pools": _split_cache(cache),
             "hist": jnp.zeros((max_slots, max_context), jnp.int32),
-            # per-request sampling penalty state: prompt-token presence
-            # and generated-token counts per slot (advanced only by
-            # rows-mode dispatches — see sampling.SamplingRows)
-            "prompt_mask": jnp.zeros((max_slots, cfg.vocab_size), bool),
-            "out_counts": jnp.zeros((max_slots, cfg.vocab_size),
-                                    jnp.int32),
         }
         if draft_cfg is not None:
             dcache = paged_engine.init_paged_cache(
@@ -701,6 +712,21 @@ class PagedInferenceServer:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
+    def _ensure_penalty_state(self) -> None:
+        """Materialize the (B, V) penalty buffers on first need (one-time
+        recompile; pre-materialization slots carry neutral penalties,
+        for which the buffers are read-irrelevant)."""
+        if "prompt_mask" in self.state:
+            return
+        pm = jnp.zeros((self.max_slots, self.cfg.vocab_size), bool)
+        oc = jnp.zeros((self.max_slots, self.cfg.vocab_size), jnp.int32)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            pm = jax.device_put(pm, NamedSharding(self.mesh, P()))
+            oc = jax.device_put(oc, NamedSharding(self.mesh, P()))
+        self.state["prompt_mask"] = pm
+        self.state["out_counts"] = oc
+
     def _emit(self, req: Request, token: int, logprob: float) -> bool:
         done = emit_token(req, token, logprob, self.infer_cfg)
         if not (done and req.finish_reason == "eos"):
@@ -806,6 +832,9 @@ class PagedInferenceServer:
                 self._needs_rows[slot_id] = (
                     req.sampling is not None
                     and req.sampling.needs_device_rows(self.infer_cfg))
+                if (req.sampling is not None
+                        and req.sampling.needs_penalty_state()):
+                    self._ensure_penalty_state()
                 self.orig_len[slot_id] = len(req.prompt)
                 staged.append(slot_id)
         if not staged:

@@ -145,12 +145,18 @@ class SamplingParams:
     def __post_init__(self):
         if self.temperature is not None and self.temperature < 0:
             raise ValueError("temperature must be >= 0")
+        if self.top_k is not None and not -2 ** 31 < self.top_k < 2 ** 31:
+            # rows are int32 device arrays; an unbounded value would
+            # overflow in the scheduler thread and kill the server
+            raise ValueError("top_k out of int32 range")
         if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
             raise ValueError("top_p must be in (0, 1]")
         if not 0.0 <= self.min_p < 1.0:
             raise ValueError("min_p must be in [0, 1)")
         if self.repetition_penalty <= 0.0:
             raise ValueError("repetition_penalty must be > 0")
+        if self.seed is not None and not 0 <= self.seed < 2 ** 63:
+            raise ValueError("seed must be in [0, 2**63)")
         # normalise stop to hashable tuples (callers may pass lists)
         stop = tuple(tuple(int(t) for t in s) for s in self.stop)
         if any(len(s) == 0 for s in stop):
@@ -165,10 +171,17 @@ class SamplingParams:
                 or (self.top_k is not None and self.top_k != cfg.top_k)
                 or (self.top_p is not None and self.top_p != cfg.top_p)
                 or self.min_p > 0.0
-                or self.repetition_penalty != 1.0
-                or self.presence_penalty != 0.0
-                or self.frequency_penalty != 0.0
+                or self.needs_penalty_state()
                 or self.seed is not None)
+
+    def needs_penalty_state(self) -> bool:
+        """True when sampling this request reads the (B, V) prompt-mask /
+        output-count buffers — the servers materialize those lazily on
+        the first such request, so penalty-free deployments never pay
+        their HBM or scatter cost."""
+        return (self.repetition_penalty != 1.0
+                or self.presence_penalty != 0.0
+                or self.frequency_penalty != 0.0)
 
     def resolve(self, cfg: InferConfig, default_seed: int) -> tuple:
         """Concrete (temperature, top_k, top_p, min_p, rep, pres, freq,

@@ -78,9 +78,11 @@ class SlotState:
         # per-request sampling state: parameter rows, prompt-token
         # presence (B, V) bool and generated-token counts (B, V) int32
         # for penalties. Rows are written by every admission; the count
-        # buffers advance only in rows-mode decode dispatches (they only
-        # influence penalty-enabled requests, whose lifetime forces rows
-        # mode — see step()).
+        # buffers are None until the FIRST penalty-using request
+        # materializes them (penalty-free deployments never pay their
+        # HBM or scatter cost; pre-materialization slots carry neutral
+        # penalties, for which the buffers are read-irrelevant) and then
+        # advance only in rows-mode decode dispatches.
         self.samp = samp              # SamplingRows of (B,) arrays
         self.prompt_mask = prompt_mask
         self.out_counts = out_counts
@@ -107,9 +109,7 @@ def init_slot_state(cfg: ModelConfig, max_slots: int,
         last_token=jnp.zeros((max_slots,), jnp.int32),
         active=jnp.zeros((max_slots,), bool),
         k_scale=cache.k_scale, v_scale=cache.v_scale,
-        samp=zero_rows(max_slots),
-        prompt_mask=jnp.zeros((max_slots, cfg.vocab_size), bool),
-        out_counts=jnp.zeros((max_slots, cfg.vocab_size), jnp.int32))
+        samp=zero_rows(max_slots), prompt_mask=None, out_counts=None)
 
 
 def _prompt_presence(token_rows: jnp.ndarray, true_lens: jnp.ndarray,
@@ -124,19 +124,20 @@ def _prompt_presence(token_rows: jnp.ndarray, true_lens: jnp.ndarray,
 
 
 def _admit_sampling_state(state: SlotState, samp_rows: SamplingRows,
-                          slots: jnp.ndarray, pm_rows: jnp.ndarray,
-                          first_toks: jnp.ndarray):
+                          slots: jnp.ndarray, pm_rows, first_toks):
     """Shared admission bookkeeping for per-request sampling: write the
-    group's parameter rows, the slots' prompt-presence masks (`pm_rows`,
-    from `_prompt_presence`), and reset generated-token counts to the
-    first sampled token. Always applied (cheap scatters) so a later
-    rows-mode decode sees correct state for slots admitted under the
-    static path.
+    group's parameter rows and — when the penalty buffers have been
+    materialized (`pm_rows` from `_prompt_presence`, else None) — the
+    slots' prompt-presence masks and generated-token counts reset to the
+    first sampled token.
 
     Returns (samp, prompt_mask, out_counts)."""
+    samp = set_rows(state.samp, slots, samp_rows)
+    if state.prompt_mask is None:
+        return samp, None, None
     g, v = pm_rows.shape
     oc = jnp.zeros((g, v), jnp.int32).at[jnp.arange(g), first_toks].add(1)
-    return (set_rows(state.samp, slots, samp_rows),
+    return (samp,
             state.prompt_mask.at[slots].set(pm_rows, mode="drop"),
             state.out_counts.at[slots].set(oc, mode="drop"))
 
@@ -165,13 +166,15 @@ def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
     g, pb = prompts.shape
     tmp = engine.init_cache(cfg, g, pb)
     logits, tmp = engine.prefill(params, prompts, cfg, tmp, true_lens)
-    pm_g = _prompt_presence(prompts, true_lens, logits.shape[-1])
+    has_pen = state.prompt_mask is not None
+    pm_g = (_prompt_presence(prompts, true_lens, logits.shape[-1])
+            if has_pen else None)
     if use_rows:
         # first generated token: no output counts yet
-        toks = sample_logits_rows(logits, samp_rows, true_lens,
-                                  prompt_mask=pm_g,
-                                  out_counts=jnp.zeros_like(logits,
-                                                            jnp.int32))
+        toks = sample_logits_rows(
+            logits, samp_rows, true_lens, prompt_mask=pm_g,
+            out_counts=(jnp.zeros_like(logits, jnp.int32)
+                        if has_pen else None))
     else:
         toks = sample_logits(logits, rng, infer_cfg)  # (G,)
     lps = _token_logprobs(logits, toks)  # (G,)
@@ -240,11 +243,14 @@ def _admit_batch_prefixed(params, state: SlotState, prefix_kv,
     full_rows = jnp.concatenate(
         [jnp.broadcast_to(prefix_toks[None, :], (g, p0)), remainders],
         axis=1)
-    pm_g = _prompt_presence(full_rows, new_lens, last.shape[-1])
+    has_pen = state.prompt_mask is not None
+    pm_g = (_prompt_presence(full_rows, new_lens, last.shape[-1])
+            if has_pen else None)
     if use_rows:
-        toks = sample_logits_rows(last, samp_rows, new_lens,
-                                  prompt_mask=pm_g,
-                                  out_counts=jnp.zeros_like(last, jnp.int32))
+        toks = sample_logits_rows(
+            last, samp_rows, new_lens, prompt_mask=pm_g,
+            out_counts=(jnp.zeros_like(last, jnp.int32)
+                        if has_pen else None))
     else:
         toks = sample_logits(last, rng, infer_cfg)
     lps = _token_logprobs(last, toks)
@@ -284,8 +290,10 @@ def _decode_core(params, state: SlotState, rng: jax.Array,
         tok = sample_logits_rows(logits, state.samp, state.length + 1,
                                  prompt_mask=state.prompt_mask,
                                  out_counts=out_counts)
-        out_counts = out_counts.at[
-            jnp.arange(tok.shape[0]), tok].add(state.active.astype(jnp.int32))
+        if out_counts is not None:
+            out_counts = out_counts.at[
+                jnp.arange(tok.shape[0]), tok].add(
+                    state.active.astype(jnp.int32))
     else:
         tok = sample_logits(logits, rng, infer_cfg)
     lp = _token_logprobs(logits, tok)
@@ -700,13 +708,31 @@ class InferenceServer:
             slots[i] = group[i][0]
         return rows, true_lens, slots
 
-    def _group_rows(self, group) -> tuple[SamplingRows, bool]:
-        """Padded SamplingRows for an admission burst + whether any
-        member needs the device rows path. Padding rows are zeros (their
-        slot index drops every scatter anyway)."""
-        gpad = 1
-        while gpad < len(group):
-            gpad *= 2
+    def _ensure_penalty_state(self, group) -> None:
+        """Materialize the (B, V) penalty buffers on the first admission
+        that needs them (one-time recompile of the dispatches; slots
+        admitted before materialization carry neutral penalties, for
+        which the buffers are read-irrelevant)."""
+        if self.state.prompt_mask is not None or not any(
+                req.sampling is not None
+                and req.sampling.needs_penalty_state()
+                for _, req in group):
+            return
+        s = self.state
+        v = self.cfg.vocab_size
+        self.state = SlotState(
+            k=s.k, v=s.v, length=s.length, last_token=s.last_token,
+            active=s.active, k_scale=s.k_scale, v_scale=s.v_scale,
+            samp=s.samp,
+            prompt_mask=jnp.zeros((self.max_slots, v), bool),
+            out_counts=jnp.zeros((self.max_slots, v), jnp.int32))
+
+    def _group_rows(self, group, gpad: int) -> tuple[SamplingRows, bool]:
+        """SamplingRows for an admission burst, padded to `gpad` rows
+        (the row count `_pad_group` chose — the jitted admission needs
+        the two paddings in lockstep) + whether any member needs the
+        device rows path. Padding rows are zeros (their slot index drops
+        every scatter anyway)."""
         params_list = [req.sampling for _, req in group]
         seeds = [req.seed_used for _, req in group]
         params_list += [None] * (gpad - len(group))
@@ -730,7 +756,8 @@ class InferenceServer:
         emit first tokens."""
         rows, true_lens, slots = self._pad_group(group, token_rows,
                                                  buckets)
-        samp_rows, use_rows = self._group_rows(group)
+        self._ensure_penalty_state(group)
+        samp_rows, use_rows = self._group_rows(group, rows.shape[0])
         self.state, toks, lps = run_fn(
             jnp.asarray(rows), jnp.asarray(true_lens), jnp.asarray(slots),
             jax.tree.map(jnp.asarray, samp_rows), use_rows)
