@@ -13,7 +13,8 @@ Endpoints:
                     optional "max_new_tokens" and any per-request
                     sampling field: temperature, top_k, top_p, min_p,
                     repetition_penalty, presence_penalty,
-                    frequency_penalty, seed, ignore_eos, stop (a string,
+                    frequency_penalty, seed, ignore_eos, min_tokens,
+                    logit_bias ({"token_id": bias}), stop (a string,
                     list of strings, or list of token-id lists).
                     Response is `application/x-ndjson`: one
                     {"token": id, "logprob": lp, "text": s} line per
@@ -33,6 +34,9 @@ Endpoints:
                     sends `chat.completion.chunk` deltas.
   GET  /v1/models   {"object": "list", "data": [{"id": ...}]}
   GET  /healthz     {"ok": true, "active": N, "pending": N}
+  GET  /metrics     Prometheus text exposition (occupancy, lifetime
+                    token counters, speculation efficiency, preemptions,
+                    prefix-cache hit/miss/eviction counts)
 
 Streaming text is emitted via incremental decode: each chunk is the
 SUFFIX the new tokens added to the decoded string, with a trailing
@@ -72,7 +76,8 @@ _STREAM_END = object()
 # OpenAI aliases are folded in by the endpoint parsers)
 _SAMPLING_FIELDS = ("temperature", "top_k", "top_p", "min_p",
                     "repetition_penalty", "presence_penalty",
-                    "frequency_penalty", "seed", "ignore_eos")
+                    "frequency_penalty", "seed", "ignore_eos",
+                    "min_tokens")
 
 
 def _parse_stop(stop, tokenizer) -> tuple[tuple[int, ...], ...]:
@@ -112,6 +117,16 @@ def _parse_sampling(body: dict, tokenizer) -> SamplingParams | None:
     stop = _parse_stop(body.get("stop"), tokenizer)
     if stop:
         kw["stop"] = stop
+    bias = body.get("logit_bias")
+    if bias:
+        if not isinstance(bias, dict):
+            raise ValueError('"logit_bias" must be an object mapping '
+                             "token ids to biases")
+        try:
+            kw["logit_bias"] = tuple(
+                (int(t), float(b)) for t, b in bias.items())
+        except (TypeError, ValueError) as exc:
+            raise ValueError(f'bad "logit_bias": {exc}') from exc
     if not kw:
         return None
     try:
@@ -212,6 +227,14 @@ class HttpFrontend:
                     self._json(200, {"ok": True,
                                      "active": front.srv.num_active,
                                      "pending": front.srv.num_pending})
+                elif self.path == "/metrics":
+                    body = front._metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif self.path == "/v1/models":
                     self._json(200, {
                         "object": "list",
@@ -251,6 +274,49 @@ class HttpFrontend:
         self._thread: threading.Thread | None = None
 
     # -- shared plumbing ----------------------------------------------------
+
+    def _metrics_text(self) -> str:
+        """Prometheus text exposition of the backend's counters (only
+        the ones the attached server actually has — the two backends
+        differ: the paged server adds speculation/preemption/prefix
+        stats)."""
+        import dataclasses as _dc
+        srv = self.srv
+        out = []
+
+        def emit(name, val, help_text, mtype):
+            out.append(f"# HELP cst_{name} {help_text}")
+            out.append(f"# TYPE cst_{name} {mtype}")
+            out.append(f"cst_{name} {val}")
+
+        def gauge(name, val, help_text):
+            emit(name, val, help_text, "gauge")
+
+        def counter(name, val, help_text):
+            emit(name, val, help_text, "counter")
+
+        gauge("active_slots", srv.num_active, "Requests currently decoding")
+        gauge("pending_requests", srv.num_pending, "Queued requests")
+        counter("tokens_emitted_total", getattr(srv, "tokens_emitted", 0),
+                "Lifetime generated tokens")
+        for attr, help_text in (
+                ("decode_rounds", "Lifetime decode dispatch rounds"),
+                ("decode_tokens_committed",
+                 "Lifetime tokens committed by decode rounds"),
+                ("preemptions", "Lifetime on-demand-paging preemptions")):
+            if hasattr(srv, attr):
+                counter(f"{attr}_total", getattr(srv, attr), help_text)
+        stats_fn = getattr(srv, "prefix_cache_stats", None)
+        if stats_fn is not None:
+            monotonic = ("prefix_hit_pages", "prefix_miss_pages",
+                         "evictions")
+            for k, v in _dc.asdict(stats_fn()).items():
+                if isinstance(v, (int, float)):
+                    kind = counter if k in monotonic else gauge
+                    suffix = "_total" if k in monotonic else ""
+                    kind(f"prefix_cache_{k}{suffix}", v,
+                         f"Prefix cache {k.replace('_', ' ')}")
+        return "\n".join(out) + "\n"
 
     def _encode(self, req: dict) -> list[int]:
         if "tokens" in req:

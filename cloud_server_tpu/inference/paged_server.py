@@ -67,7 +67,7 @@ import numpy as np
 from jax import lax
 
 from cloud_server_tpu.config import InferConfig, ModelConfig
-from cloud_server_tpu.inference import paged_engine
+from cloud_server_tpu.inference import paged_engine, sampling
 from cloud_server_tpu.inference.block_allocator import BlockAllocator
 from cloud_server_tpu.inference.sampling import (
     SamplingParams, SamplingRows, make_rows, sample_from_probs,
@@ -115,7 +115,7 @@ def _split_cache(cache):
 
 @partial(jax.jit,
          static_argnames=("cfg", "infer_cfg", "scatter_prompt", "mesh",
-                          "draft_cfg", "use_rows"),
+                          "draft_cfg", "use_rows", "use_bias"),
          donate_argnums=(1,))
 def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
                    slot_ids, prompt_rows, prompt_lens, rng,
@@ -123,7 +123,7 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
                    draft_params=None, *,
                    cfg: ModelConfig, infer_cfg: InferConfig,
                    scatter_prompt: bool, mesh=None, draft_cfg=None,
-                   use_rows: bool = False):
+                   use_rows: bool = False, use_bias: bool = False):
     """One admission chunk for a (padded) G-row group.
 
     chunk: (G, Wc) tokens for positions [g_lens, g_lens + Wc) per row —
@@ -179,7 +179,8 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
         toks = sample_logits_rows(
             logits, samp_rows, prompt_lens,
             prompt_mask=pm[slot_ids] if has_pen else None,
-            out_counts=oc[slot_ids] if has_pen else None)
+            out_counts=oc[slot_ids] if has_pen else None,
+            eos_id=infer_cfg.eos_token_id, use_bias=use_bias)
     else:
         toks = sample_logits(logits, rng, infer_cfg)
     lps = _token_logprobs(logits, toks)
@@ -212,12 +213,12 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
 
 @partial(jax.jit,
          static_argnames=("cfg", "infer_cfg", "n_rounds", "mesh",
-                          "use_rows"),
+                          "use_rows", "use_bias"),
          donate_argnums=(1,))
 def _decode_rounds(params, state, lengths, tables, last_token, live,
                    rng, samp_rows, *, cfg: ModelConfig,
                    infer_cfg: InferConfig, n_rounds: int, mesh=None,
-                   use_rows: bool = False):
+                   use_rows: bool = False, use_bias: bool = False):
     """n_rounds plain decode steps (W=1) in one dispatch (lax.scan).
 
     `live` slots advance one token per round; the rest are frozen (their
@@ -248,7 +249,9 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
             # occupies `lengths`); the admission chunk folds the prompt
             # length, so positions never collide within a request
             tok = sample_logits_rows(logits, samp_rows, lengths + 1,
-                                     prompt_mask=pm, out_counts=oc)
+                                     prompt_mask=pm, out_counts=oc,
+                                     eos_id=infer_cfg.eos_token_id,
+                                     use_bias=use_bias)
             if oc is not None:
                 oc = oc.at[batch_idx, tok].add(live.astype(jnp.int32))
         else:
@@ -274,13 +277,13 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
 
 @partial(jax.jit,
          static_argnames=("cfg", "infer_cfg", "n_rounds", "n_drafts",
-                          "mesh", "draft_cfg", "use_rows"),
+                          "mesh", "draft_cfg", "use_rows", "use_bias"),
          donate_argnums=(1,))
 def _spec_rounds(params, state, lengths, tables, last_token, live,
                  stop_len, rng, samp_rows, draft_params=None, *,
                  cfg: ModelConfig, infer_cfg: InferConfig, n_rounds: int,
                  n_drafts: int, mesh=None, draft_cfg=None,
-                 use_rows: bool = False):
+                 use_rows: bool = False, use_bias: bool = False):
     """n_rounds speculative rounds in one dispatch.
 
     Each round drafts `n_drafts` tokens per slot — from a DRAFT MODEL
@@ -337,9 +340,10 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
                     draft_params, tok[:, None], draft_cfg, dcache,
                     logits_at=jnp.zeros_like(lengths), mesh=mesh)
                 if use_rows:
-                    qp = sampling_probs_rows(dlogits, samp_rows,
-                                             prompt_mask=pm,
-                                             out_counts=cnt)
+                    qp = sampling_probs_rows(
+                        dlogits, samp_rows, prompt_mask=pm,
+                        out_counts=cnt, positions=lengths + 1 + off,
+                        eos_id=infer_cfg.eos_token_id, use_bias=use_bias)
                 else:
                     qp = sampling_probs(dlogits, infer_cfg)
                 nxt = sample_from_probs(qp, rng_d)
@@ -381,11 +385,15 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
                                dtype=jnp.int32), axis=1)
             counts_w = oc[:, None, :] + jnp.concatenate(
                 [jnp.zeros_like(cum[:, :1]), cum], axis=1)
-            p_probs = sampling_probs_rows(vlogits, samp_rows,
-                                          prompt_mask=pm,
-                                          out_counts=counts_w)
+            p_probs = sampling_probs_rows(
+                vlogits, samp_rows, prompt_mask=pm, out_counts=counts_w,
+                positions=(lengths + 1)[:, None] + j,
+                eos_id=infer_cfg.eos_token_id, use_bias=use_bias)
         elif use_rows:
-            p_probs = sampling_probs_rows(vlogits, samp_rows)
+            p_probs = sampling_probs_rows(
+                vlogits, samp_rows,
+                positions=(lengths + 1)[:, None] + j,
+                eos_id=infer_cfg.eos_token_id, use_bias=use_bias)
         else:
             p_probs = sampling_probs(vlogits, infer_cfg)  # (B, G+1, V)
         if use_draft:
@@ -624,6 +632,7 @@ class PagedInferenceServer:
         self.samp_rows = make_rows([None] * max_slots, infer_cfg,
                                    [0] * max_slots)
         self._needs_rows = np.zeros((max_slots,), bool)
+        self._has_bias = np.zeros((max_slots,), bool)
         self.orig_len = np.zeros((max_slots,), np.int32)
         self._host_rng = np.random.default_rng(seed)
 
@@ -760,6 +769,7 @@ class PagedInferenceServer:
         self.active[slot_id] = False
         self.lengths[slot_id] = 0
         self._needs_rows[slot_id] = False  # don't pin rows-mode dispatch
+        self._has_bias[slot_id] = False
         return slot
 
     def _finish(self, slot_id: int) -> None:
@@ -826,12 +836,16 @@ class PagedInferenceServer:
                 # per-request sampling rows (seed stable across
                 # preemption: seed_used was fixed at submit)
                 row = make_rows([req.sampling], self.infer_cfg,
-                                [req.seed_used])
+                                [req.seed_used],
+                                prompt_lens=[len(req.prompt)])
                 for dst, src in zip(self.samp_rows, row):
                     dst[slot_id] = src[0]
                 self._needs_rows[slot_id] = (
                     req.sampling is not None
                     and req.sampling.needs_device_rows(self.infer_cfg))
+                self._has_bias[slot_id] = (
+                    req.sampling is not None
+                    and bool(req.sampling.logit_bias))
                 if (req.sampling is not None
                         and req.sampling.needs_penalty_state()):
                     self._ensure_penalty_state()
@@ -898,16 +912,19 @@ class PagedInferenceServer:
         prompt_rows = pad_rows(job.prompt_rows, self.infer_cfg.pad_token_id)
         prompt_lens = pad_rows(job.prompt_lens, 0)
         sl = np.asarray(job.slots)
-        # padding rows get NEUTRAL values (temp 0 = greedy, rep/top_p 1):
-        # their samples are discarded, but rep=0 would divide to inf/NaN
-        # and trip jax_debug_nans even on discarded rows
-        _fills = (0.0, 0, 1.0, 0.0, 1.0, 0.0, 0.0, 0)
-        samp_g = SamplingRows(*[pad_rows(dst[sl], fill)
-                                for dst, fill in zip(self.samp_rows,
-                                                     _fills)])
+        # padding rows get NEUTRAL values (temp 0 = greedy, rep/top_p 1,
+        # bias slots out-of-vocab): their samples are discarded, but
+        # rep=0 would divide to inf/NaN and trip jax_debug_nans even on
+        # discarded rows
+        _fills = {"top_p": 1.0, "rep": 1.0,
+                  "bias_ids": sampling._BIAS_PAD}
+        samp_g = SamplingRows(*[
+            pad_rows(dst[sl], _fills.get(name, 0))
+            for name, dst in zip(SamplingRows._fields, self.samp_rows)])
         orig_lens = pad_rows(self.orig_len[sl], 0)
         count_mask = pad_rows(in_range, False)
         use_rows = bool(self._needs_rows[sl].any())
+        use_bias = bool(self._has_bias[sl].any())
 
         self.state, toks, lps = _prefill_chunk(
             self.params, self.state, jnp.asarray(chunk),
@@ -919,7 +936,8 @@ class PagedInferenceServer:
             self.draft_params,
             cfg=self.cfg, infer_cfg=self.infer_cfg,
             scatter_prompt=(c == 0), mesh=self.mesh,
-            draft_cfg=self.draft_cfg, use_rows=use_rows)
+            draft_cfg=self.draft_cfg, use_rows=use_rows,
+            use_bias=use_bias)
         toks, lps = jax.device_get((toks, lps))
         toks, lps = np.asarray(toks)[:g], np.asarray(lps)[:g]
         job.toks = np.where(in_range, toks, job.toks)
@@ -1050,6 +1068,7 @@ class PagedInferenceServer:
                 jnp.asarray(self.last_token), jnp.asarray(live))
         samp = jax.tree.map(jnp.asarray, self.samp_rows)
         use_rows = bool((self._needs_rows & live).any())
+        use_bias = bool((self._has_bias & live).any())
         if self.spec_drafts > 0:
             self.state, lens, last, (toks, lps, counts) = _spec_rounds(
                 self.params, self.state, *args,
@@ -1057,14 +1076,15 @@ class PagedInferenceServer:
                 self.draft_params,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
                 n_drafts=self.spec_drafts, mesh=self.mesh,
-                draft_cfg=self.draft_cfg, use_rows=use_rows)
+                draft_cfg=self.draft_cfg, use_rows=use_rows,
+                use_bias=use_bias)
             toks, lps, counts, lens, last = jax.device_get(
                 (toks, lps, counts, lens, last))
         else:
             self.state, lens, last, (toks, lps, counts) = _decode_rounds(
                 self.params, self.state, *args, self._next_rng(), samp,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
-                mesh=self.mesh, use_rows=use_rows)
+                mesh=self.mesh, use_rows=use_rows, use_bias=use_bias)
             toks, lps, counts, lens, last = jax.device_get(
                 (toks, lps, counts, lens, last))
             toks, lps = toks[:, :, None], lps[:, :, None]

@@ -31,6 +31,7 @@ repetition_penalty (HF-style) spans prompt and generated tokens.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import NamedTuple, Sequence
 
 import jax
@@ -41,6 +42,10 @@ from jax import lax
 from cloud_server_tpu.config import InferConfig
 
 NEG_INF = -1e30
+MAX_LOGIT_BIAS = 64  # static per-row logit_bias slots in SamplingRows
+# padding token id for unused bias slots: far out of any vocab range, so
+# mode="drop" scatters discard it (negative ids would wrap)
+_BIAS_PAD = 2 ** 30
 
 
 # ---------------------------------------------------------------------------
@@ -141,6 +146,12 @@ class SamplingParams:
     seed: int | None = None
     stop: tuple[tuple[int, ...], ...] = ()
     ignore_eos: bool = False
+    # additive per-token logit adjustments ((token_id, bias) pairs, up
+    # to MAX_LOGIT_BIAS) applied before the filter chain — OpenAI
+    # logit_bias semantics
+    logit_bias: tuple[tuple[int, float], ...] = ()
+    # suppress EOS until this many tokens have been generated
+    min_tokens: int = 0
 
     def __post_init__(self):
         if self.temperature is not None and self.temperature < 0:
@@ -162,6 +173,16 @@ class SamplingParams:
         if any(len(s) == 0 for s in stop):
             raise ValueError("empty stop sequence")
         object.__setattr__(self, "stop", stop)
+        bias = tuple((int(t), float(b)) for t, b in self.logit_bias)
+        if len(bias) > MAX_LOGIT_BIAS:
+            raise ValueError(
+                f"at most {MAX_LOGIT_BIAS} logit_bias entries")
+        if any(t < 0 or not math.isfinite(b) for t, b in bias):
+            raise ValueError("logit_bias needs token ids >= 0 and finite "
+                             "biases")
+        object.__setattr__(self, "logit_bias", bias)
+        if not 0 <= self.min_tokens < 2 ** 31:
+            raise ValueError("min_tokens must be a small non-negative int")
 
     def needs_device_rows(self, cfg: InferConfig) -> bool:
         """True when this request's DEVICE-side sampling differs from the
@@ -172,7 +193,9 @@ class SamplingParams:
                 or (self.top_p is not None and self.top_p != cfg.top_p)
                 or self.min_p > 0.0
                 or self.needs_penalty_state()
-                or self.seed is not None)
+                or self.seed is not None
+                or bool(self.logit_bias)
+                or self.min_tokens > 0)
 
     def needs_penalty_state(self) -> bool:
         """True when sampling this request reads the (B, V) prompt-mask /
@@ -185,19 +208,25 @@ class SamplingParams:
 
     def resolve(self, cfg: InferConfig, default_seed: int) -> tuple:
         """Concrete (temperature, top_k, top_p, min_p, rep, pres, freq,
-        seed) row values with `None` fields inherited from `cfg`."""
+        seed, bias_ids, bias_vals, min_new) row values with `None`
+        fields inherited from `cfg` and logit_bias padded to
+        MAX_LOGIT_BIAS slots."""
+        ids = [t for t, _ in self.logit_bias]
+        vals = [b for _, b in self.logit_bias]
+        pad = MAX_LOGIT_BIAS - len(ids)
         return (
             cfg.temperature if self.temperature is None else self.temperature,
             cfg.top_k if self.top_k is None else self.top_k,
             cfg.top_p if self.top_p is None else self.top_p,
             self.min_p, self.repetition_penalty, self.presence_penalty,
             self.frequency_penalty,
-            default_seed if self.seed is None else self.seed)
+            default_seed if self.seed is None else self.seed,
+            ids + [_BIAS_PAD] * pad, vals + [0.0] * pad, self.min_tokens)
 
 
 class SamplingRows(NamedTuple):
-    """Per-slot sampling parameters as device rows (a pytree of (B,)
-    arrays — traced jit inputs, never statics)."""
+    """Per-slot sampling parameters as device rows (a pytree of (B,) or
+    (B, K) arrays — traced jit inputs, never statics)."""
 
     temperature: jnp.ndarray  # (B,) f32; <= 0 means greedy for that row
     top_k: jnp.ndarray        # (B,) i32; <= 0 disables
@@ -207,16 +236,25 @@ class SamplingRows(NamedTuple):
     pres: jnp.ndarray         # (B,) f32 presence penalty
     freq: jnp.ndarray         # (B,) f32 frequency penalty
     seed: jnp.ndarray         # (B,) uint32 per-request PRNG seed
+    bias_ids: jnp.ndarray     # (B, MAX_LOGIT_BIAS) i32, _BIAS_PAD unused
+    bias_vals: jnp.ndarray    # (B, MAX_LOGIT_BIAS) f32
+    min_new: jnp.ndarray      # (B,) i32 min generated tokens before EOS
+    plen: jnp.ndarray         # (B,) i32 original prompt length (set by
+    #                           the server at admission — generated-count
+    #                           accounting for min_new)
 
 
 def make_rows(params_list: Sequence[SamplingParams | None],
-              cfg: InferConfig,
-              default_seeds: Sequence[int]) -> SamplingRows:
+              cfg: InferConfig, default_seeds: Sequence[int],
+              prompt_lens: Sequence[int] | None = None) -> SamplingRows:
     """Host-side builder: one numpy row per request (jnp.asarray at the
-    dispatch boundary)."""
+    dispatch boundary). `prompt_lens` are the ORIGINAL prompt lengths
+    (min_tokens accounting); zeros when omitted."""
     vals = [(p or SamplingParams()).resolve(cfg, int(s))
             for p, s in zip(params_list, default_seeds)]
-    t, k, p, mp, rep, pres, freq, seed = zip(*vals)
+    t, k, p, mp, rep, pres, freq, seed, bids, bvals, mn = zip(*vals)
+    if prompt_lens is None:
+        prompt_lens = [0] * len(vals)
     return SamplingRows(
         temperature=np.asarray(t, np.float32),
         top_k=np.asarray(k, np.int32),
@@ -225,7 +263,11 @@ def make_rows(params_list: Sequence[SamplingParams | None],
         rep=np.asarray(rep, np.float32),
         pres=np.asarray(pres, np.float32),
         freq=np.asarray(freq, np.float32),
-        seed=np.asarray(np.asarray(seed, np.int64) & 0xFFFFFFFF, np.uint32))
+        seed=np.asarray(np.asarray(seed, np.int64) & 0xFFFFFFFF, np.uint32),
+        bias_ids=np.asarray(bids, np.int32),
+        bias_vals=np.asarray(bvals, np.float32),
+        min_new=np.asarray(mn, np.int32),
+        plen=np.asarray(prompt_lens, np.int32))
 
 
 def zero_rows(n: int) -> SamplingRows:
@@ -239,7 +281,11 @@ def zero_rows(n: int) -> SamplingRows:
         rep=jnp.ones((n,), jnp.float32),
         pres=jnp.zeros((n,), jnp.float32),
         freq=jnp.zeros((n,), jnp.float32),
-        seed=jnp.zeros((n,), jnp.uint32))
+        seed=jnp.zeros((n,), jnp.uint32),
+        bias_ids=jnp.full((n, MAX_LOGIT_BIAS), _BIAS_PAD, jnp.int32),
+        bias_vals=jnp.zeros((n, MAX_LOGIT_BIAS), jnp.float32),
+        min_new=jnp.zeros((n,), jnp.int32),
+        plen=jnp.zeros((n,), jnp.int32))
 
 
 def set_rows(state: SamplingRows, slots: jnp.ndarray,
@@ -274,14 +320,41 @@ def penalised_logits(logits: jnp.ndarray, rows: SamplingRows,
 
 def filtered_logits_rows(logits: jnp.ndarray, rows: SamplingRows, *,
                          prompt_mask: jnp.ndarray | None = None,
-                         out_counts: jnp.ndarray | None = None):
-    """Per-row filter chain over (B, ..., V) logits.
+                         out_counts: jnp.ndarray | None = None,
+                         positions: jnp.ndarray | None = None,
+                         eos_id: int = -1, use_bias: bool = True):
+    """Per-row filter chain over (B, ..., V) logits: logit_bias ->
+    penalties -> min_tokens EOS suppression -> temperature -> top-k ->
+    top-p -> min-p. `positions` (logits.shape[:-1]) are the absolute
+    sequence positions being sampled — with `eos_id`, they drive the
+    min_tokens suppression (generated-so-far = position - plen).
+    `use_bias` is the servers' static no-bias-in-batch gate (the (B, V)
+    bias table shouldn't tax rows-mode batches that never asked for it).
 
     Returns (filtered logits for categorical draws, post-penalty
     pre-temperature logits — the greedy-row argmax source)."""
     x = logits.astype(jnp.float32)
+    b = x.shape[0]
+    if use_bias:
+        # logit_bias: build a per-row (B, V) additive table once
+        # (padding slots point far out of the vocab and drop),
+        # broadcast over any window dimension
+        bias = jnp.zeros((b, x.shape[-1]), jnp.float32).at[
+            jnp.arange(b)[:, None], rows.bias_ids].add(rows.bias_vals,
+                                                       mode="drop")
+        x = x + bias.reshape(bias.shape[:1] + (1,) * (x.ndim - 2)
+                             + bias.shape[1:])
     if prompt_mask is not None:
         x = penalised_logits(x, rows, prompt_mask, out_counts)
+    if positions is not None and eos_id >= 0:
+        # min_tokens: the token at absolute position p is generated
+        # index p - plen; suppress EOS while that is < min_new
+        gen = positions - rows.plen.reshape(
+            (b,) + (1,) * (positions.ndim - 1))
+        suppress = (gen < rows.min_new.reshape(
+            (b,) + (1,) * (positions.ndim - 1)))[..., None]
+        x = jnp.where(suppress & (jnp.arange(x.shape[-1]) == eos_id),
+                      NEG_INF, x)
     raw = x
     xt = x / jnp.maximum(_expand(rows.temperature, x), 1e-6)
     v = x.shape[-1]
@@ -315,12 +388,16 @@ def _row_keys(rows: SamplingRows, positions: jnp.ndarray) -> jax.Array:
 def sample_logits_rows(logits: jnp.ndarray, rows: SamplingRows,
                        positions: jnp.ndarray, *,
                        prompt_mask: jnp.ndarray | None = None,
-                       out_counts: jnp.ndarray | None = None
-                       ) -> jnp.ndarray:
+                       out_counts: jnp.ndarray | None = None,
+                       eos_id: int = -1,
+                       use_bias: bool = True) -> jnp.ndarray:
     """Per-row draw: (B, V) logits -> (B,) int32. `positions` (B,) is the
-    absolute sequence position being sampled (the fold_in counter)."""
+    absolute sequence position being sampled (the fold_in counter and
+    the min_tokens generated-count reference)."""
     filt, raw = filtered_logits_rows(logits, rows, prompt_mask=prompt_mask,
-                                     out_counts=out_counts)
+                                     out_counts=out_counts,
+                                     positions=positions, eos_id=eos_id,
+                                     use_bias=use_bias)
     keys = _row_keys(rows, positions)
     sampled = jax.vmap(jax.random.categorical)(keys, filt)
     greedy = jnp.argmax(raw, axis=-1)
@@ -330,14 +407,19 @@ def sample_logits_rows(logits: jnp.ndarray, rows: SamplingRows,
 
 def sampling_probs_rows(logits: jnp.ndarray, rows: SamplingRows, *,
                         prompt_mask: jnp.ndarray | None = None,
-                        out_counts: jnp.ndarray | None = None
-                        ) -> jnp.ndarray:
+                        out_counts: jnp.ndarray | None = None,
+                        positions: jnp.ndarray | None = None,
+                        eos_id: int = -1,
+                        use_bias: bool = True) -> jnp.ndarray:
     """Rows analogue of `sampling_probs`: the exact per-row distribution
     `sample_logits_rows` draws from, over (B, ..., V) logits (speculative
-    verification scores whole windows — pass cumulative `out_counts`
-    matching the window so penalties stay exact position by position)."""
+    verification scores whole windows — pass cumulative `out_counts` and
+    per-position `positions` matching the window so penalties and
+    min_tokens stay exact position by position)."""
     filt, raw = filtered_logits_rows(logits, rows, prompt_mask=prompt_mask,
-                                     out_counts=out_counts)
+                                     out_counts=out_counts,
+                                     positions=positions, eos_id=eos_id,
+                                     use_bias=use_bias)
     probs = jax.nn.softmax(filt, axis=-1)
     onehot = jax.nn.one_hot(jnp.argmax(raw, axis=-1), logits.shape[-1],
                             dtype=probs.dtype)

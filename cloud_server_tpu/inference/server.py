@@ -142,12 +142,14 @@ def _admit_sampling_state(state: SlotState, samp_rows: SamplingRows,
             state.out_counts.at[slots].set(oc, mode="drop"))
 
 
-@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "use_rows"),
+@partial(jax.jit,
+         static_argnames=("cfg", "infer_cfg", "use_rows", "use_bias"),
          donate_argnums=(1,))
 def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
                  true_lens: jnp.ndarray, slots: jnp.ndarray, rng: jax.Array,
                  samp_rows: SamplingRows, *, cfg: ModelConfig,
-                 infer_cfg: InferConfig, use_rows: bool = False):
+                 infer_cfg: InferConfig, use_rows: bool = False,
+                 use_bias: bool = False):
     """Prefill G prompts (G, Pb) into `slots` (G,); sample first tokens.
 
     A whole admission burst is ONE batched prefill (full MXU batch) instead
@@ -174,7 +176,8 @@ def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
         toks = sample_logits_rows(
             logits, samp_rows, true_lens, prompt_mask=pm_g,
             out_counts=(jnp.zeros_like(logits, jnp.int32)
-                        if has_pen else None))
+                        if has_pen else None),
+            eos_id=infer_cfg.eos_token_id, use_bias=use_bias)
     else:
         toks = sample_logits(logits, rng, infer_cfg)  # (G,)
     lps = _token_logprobs(logits, toks)  # (G,)
@@ -198,14 +201,16 @@ def _admit_batch(params, state: SlotState, prompts: jnp.ndarray,
         out_counts=counts), toks, lps
 
 
-@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "use_rows"),
+@partial(jax.jit,
+         static_argnames=("cfg", "infer_cfg", "use_rows", "use_bias"),
          donate_argnums=(1,))
 def _admit_batch_prefixed(params, state: SlotState, prefix_kv,
                           remainders: jnp.ndarray,
                           true_lens: jnp.ndarray, slots: jnp.ndarray,
                           rng: jax.Array, samp_rows: SamplingRows,
                           prefix_toks: jnp.ndarray, *, cfg: ModelConfig,
-                          infer_cfg: InferConfig, use_rows: bool = False):
+                          infer_cfg: InferConfig, use_rows: bool = False,
+                          use_bias: bool = False):
     """Admission via a cached common-prefix KV (prefix caching).
 
     The prefix's cache entries (prefix_kv: dict with k/v (L, 1, P0, KH,
@@ -250,7 +255,8 @@ def _admit_batch_prefixed(params, state: SlotState, prefix_kv,
         toks = sample_logits_rows(
             last, samp_rows, new_lens, prompt_mask=pm_g,
             out_counts=(jnp.zeros_like(last, jnp.int32)
-                        if has_pen else None))
+                        if has_pen else None),
+            eos_id=infer_cfg.eos_token_id, use_bias=use_bias)
     else:
         toks = sample_logits(last, rng, infer_cfg)
     lps = _token_logprobs(last, toks)
@@ -277,7 +283,7 @@ def _admit_batch_prefixed(params, state: SlotState, prefix_kv,
 
 def _decode_core(params, state: SlotState, rng: jax.Array,
                  cfg: ModelConfig, infer_cfg: InferConfig,
-                 use_rows: bool = False):
+                 use_rows: bool = False, use_bias: bool = False):
     """One decode step over all slots; inactive slots are frozen."""
     cache = engine.KVCache(state.k, state.v, state.length,
                            state.k_scale, state.v_scale)
@@ -289,7 +295,9 @@ def _decode_core(params, state: SlotState, rng: jax.Array,
         # first token, so positions never collide within a request
         tok = sample_logits_rows(logits, state.samp, state.length + 1,
                                  prompt_mask=state.prompt_mask,
-                                 out_counts=out_counts)
+                                 out_counts=out_counts,
+                                 eos_id=infer_cfg.eos_token_id,
+                                 use_bias=use_bias)
         if out_counts is not None:
             out_counts = out_counts.at[
                 jnp.arange(tok.shape[0]), tok].add(
@@ -306,21 +314,24 @@ def _decode_core(params, state: SlotState, rng: jax.Array,
                      out_counts=out_counts), (tok, lp)
 
 
-@partial(jax.jit, static_argnames=("cfg", "infer_cfg", "use_rows"),
+@partial(jax.jit,
+         static_argnames=("cfg", "infer_cfg", "use_rows", "use_bias"),
          donate_argnums=(1,))
 def _decode(params, state: SlotState, rng: jax.Array, *, cfg: ModelConfig,
-            infer_cfg: InferConfig, use_rows: bool = False):
+            infer_cfg: InferConfig, use_rows: bool = False,
+            use_bias: bool = False):
     """Returns (state', (tokens (B,) int32, logprobs (B,) f32)) with pad
     in inactive rows."""
-    return _decode_core(params, state, rng, cfg, infer_cfg, use_rows)
+    return _decode_core(params, state, rng, cfg, infer_cfg, use_rows,
+                        use_bias)
 
 
 @partial(jax.jit, static_argnames=("cfg", "infer_cfg", "n_steps",
-                                   "use_rows"),
+                                   "use_rows", "use_bias"),
          donate_argnums=(1,))
 def _decode_chunk(params, state: SlotState, rng: jax.Array, *,
                   cfg: ModelConfig, infer_cfg: InferConfig, n_steps: int,
-                  use_rows: bool = False):
+                  use_rows: bool = False, use_bias: bool = False):
     """n_steps decode steps in ONE dispatch (lax.scan on device).
 
     Multi-token scheduling: the host syncs (device_get of the sampled
@@ -334,7 +345,8 @@ def _decode_chunk(params, state: SlotState, rng: jax.Array, *,
     logprobs (n_steps, B) f32)).
     """
     def body(st, r):
-        return _decode_core(params, st, r, cfg, infer_cfg, use_rows)
+        return _decode_core(params, st, r, cfg, infer_cfg, use_rows,
+                            use_bias)
 
     return lax.scan(body, state, jax.random.split(rng, n_steps))
 
@@ -735,21 +747,27 @@ class InferenceServer:
         every scatter anyway)."""
         params_list = [req.sampling for _, req in group]
         seeds = [req.seed_used for _, req in group]
+        plens = [len(req.prompt) for _, req in group]
         params_list += [None] * (gpad - len(group))
         seeds += [0] * (gpad - len(group))
-        rows = make_rows(params_list, self.infer_cfg, seeds)
+        plens += [0] * (gpad - len(group))
+        rows = make_rows(params_list, self.infer_cfg, seeds,
+                         prompt_lens=plens)
         use = any(sp is not None and sp.needs_device_rows(self.infer_cfg)
                   for sp in params_list)
-        return rows, use
+        bias = any(sp is not None and bool(sp.logit_bias)
+                   for sp in params_list)
+        return rows, use, bias
 
-    def _rows_mode(self) -> bool:
-        """True when any ACTIVE request needs per-request device
-        sampling — that request's whole lifetime then runs rows-mode
-        dispatches, which is what keeps its penalty counts advancing."""
-        return any(
-            r is not None and r.sampling is not None
-            and r.sampling.needs_device_rows(self.infer_cfg)
-            for r in self._slots)
+    def _rows_mode(self) -> tuple[bool, bool]:
+        """(use_rows, use_bias): whether any ACTIVE request needs
+        per-request device sampling / logit_bias — such a request's
+        whole lifetime then runs rows-mode dispatches, which is what
+        keeps its penalty counts advancing."""
+        live = [r.sampling for r in self._slots
+                if r is not None and r.sampling is not None]
+        return (any(sp.needs_device_rows(self.infer_cfg) for sp in live),
+                any(bool(sp.logit_bias) for sp in live))
 
     def _admit_group(self, group, token_rows, buckets, run_fn) -> None:
         """Shared burst plumbing: pad, dispatch one batched admission,
@@ -757,21 +775,22 @@ class InferenceServer:
         rows, true_lens, slots = self._pad_group(group, token_rows,
                                                  buckets)
         self._ensure_penalty_state(group)
-        samp_rows, use_rows = self._group_rows(group, rows.shape[0])
+        samp_rows, use_rows, use_bias = self._group_rows(
+            group, rows.shape[0])
         self.state, toks, lps = run_fn(
             jnp.asarray(rows), jnp.asarray(true_lens), jnp.asarray(slots),
-            jax.tree.map(jnp.asarray, samp_rows), use_rows)
+            jax.tree.map(jnp.asarray, samp_rows), use_rows, use_bias)
         toks, lps = jax.device_get((toks, lps))
         for i, (slot, req) in enumerate(group):
             if self._emit(req, int(toks[i]), float(lps[i])):
                 self._finish(slot, req)
 
     def _admit_group_plain(self, group) -> None:
-        def run(rows, tl, sl, samp, use_rows):
+        def run(rows, tl, sl, samp, use_rows, use_bias):
             return _admit_batch(self.params, self.state, rows, tl, sl,
                                 self._next_rng(), samp, cfg=self.cfg,
                                 infer_cfg=self.infer_cfg,
-                                use_rows=use_rows)
+                                use_rows=use_rows, use_bias=use_bias)
 
         self._admit_group(group, [r.prompt for _, r in group],
                           self.prompt_buckets, run)
@@ -779,12 +798,13 @@ class InferenceServer:
     def _admit_group_prefixed(self, group) -> None:
         p0 = len(self._prefix)
 
-        def run(rows, tl, sl, samp, use_rows):
+        def run(rows, tl, sl, samp, use_rows, use_bias):
             return _admit_batch_prefixed(
                 self.params, self.state, self._prefix_kv, rows, tl, sl,
                 self._next_rng(), samp,
                 jnp.asarray(self._prefix, jnp.int32), cfg=self.cfg,
-                infer_cfg=self.infer_cfg, use_rows=use_rows)
+                infer_cfg=self.infer_cfg, use_rows=use_rows,
+                use_bias=use_bias)
 
         self._admit_group(group, [req.prompt[p0:] for _, req in group],
                           self._rem_buckets, run)
@@ -822,12 +842,12 @@ class InferenceServer:
             if self.num_active == 0:
                 return 0
             n = self._chunk_len()
-            use_rows = self._rows_mode()
+            use_rows, use_bias = self._rows_mode()
             if n == 1:
                 self.state, out = _decode(
                     self.params, self.state, self._next_rng(),
                     cfg=self.cfg, infer_cfg=self.infer_cfg,
-                    use_rows=use_rows)
+                    use_rows=use_rows, use_bias=use_bias)
                 toks, lps = jax.device_get(out)
                 chunk = np.asarray(toks)[None]       # (1, B)
                 lchunk = np.asarray(lps)[None]
@@ -835,7 +855,7 @@ class InferenceServer:
                 self.state, out = _decode_chunk(
                     self.params, self.state, self._next_rng(),
                     cfg=self.cfg, infer_cfg=self.infer_cfg, n_steps=n,
-                    use_rows=use_rows)
+                    use_rows=use_rows, use_bias=use_bias)
                 toks, lps = jax.device_get(out)
                 chunk = np.asarray(toks)             # (n, B)
                 lchunk = np.asarray(lps)

@@ -220,3 +220,29 @@ def test_v1_errors(frontend):
         _raw_post(front, {"prompt": "ab", "temperature": -2.0},
                   "/v1/completions")
     assert err.value.code == 400
+
+
+def test_logit_bias_and_min_tokens_over_http(frontend):
+    front, _ = frontend
+    lines = _post(front, {"tokens": [5, 9, 3], "max_new_tokens": 4,
+                          "logit_bias": {"42": 1e9}})
+    assert lines[-1]["tokens"] == [42, 42, 42, 42]
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(front, {"tokens": [5], "logit_bias": {"x": 1}})
+    assert err.value.code == 400
+
+
+def test_metrics_endpoint(frontend):
+    front, _ = frontend
+    _post(front, {"tokens": [5, 9, 3], "max_new_tokens": 2})
+    host, port = front.address
+    with urllib.request.urlopen(f"http://{host}:{port}/metrics",
+                                timeout=30) as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    lines = dict(ln.rsplit(" ", 1) for ln in text.splitlines()
+                 if ln and not ln.startswith("#"))
+    assert float(lines["cst_tokens_emitted_total"]) >= 2
+    assert "cst_active_slots" in lines
+    if hasattr(front.srv, "allocator"):
+        assert "cst_prefix_cache_pages_total" in lines

@@ -288,3 +288,52 @@ def test_preemption_preserves_sampling(params):
     tight.run_until_idle()
     del want, others
     assert r.result() == r_ref.result()
+
+
+# ---------------------------------------------------------------------------
+# logit_bias / min_tokens
+# ---------------------------------------------------------------------------
+
+
+def test_logit_bias_forces_and_forbids(params):
+    """A large positive bias forces a token; a large negative bias
+    forbids one — through the live paged server, greedy."""
+    srv = PagedInferenceServer(params, CFG, GREEDY, **PAGED_KW)
+    forced = srv.submit(PROMPTS[0], max_new_tokens=4,
+                        sampling=SamplingParams(logit_bias=((42, 1e9),)))
+    plain = srv.submit(PROMPTS[0], max_new_tokens=4)
+    srv.run_until_idle()
+    assert forced.result() == [42, 42, 42, 42]
+    ban = plain.result()[0]
+    srv2 = PagedInferenceServer(params, CFG, GREEDY, **PAGED_KW)
+    banned = srv2.submit(PROMPTS[0], max_new_tokens=4,
+                         sampling=SamplingParams(logit_bias=((ban, -1e9),)))
+    srv2.run_until_idle()
+    assert ban not in banned.result()
+
+
+def test_logit_bias_validation():
+    with pytest.raises(ValueError):
+        SamplingParams(logit_bias=tuple((i, 1.0) for i in range(65)))
+    with pytest.raises(ValueError):
+        SamplingParams(logit_bias=((-1, 1.0),))
+    with pytest.raises(ValueError):
+        SamplingParams(min_tokens=-1)
+
+
+@pytest.mark.parametrize("spec_drafts", [0, 2])
+def test_min_tokens_suppresses_eos(params, spec_drafts):
+    """With EOS biased to +inf the model would stop immediately;
+    min_tokens forces exactly that many tokens first — and the
+    suppression stays exact through speculative windows."""
+    eos_cfg = dataclasses.replace(GREEDY, eos_token_id=13)
+    srv = PagedInferenceServer(params, CFG, eos_cfg,
+                               spec_drafts=spec_drafts, **PAGED_KW)
+    sp = SamplingParams(logit_bias=((13, 1e9),), min_tokens=5)
+    r = srv.submit(PROMPTS[0], max_new_tokens=10, sampling=sp)
+    rush = srv.submit(PROMPTS[0], max_new_tokens=10,
+                      sampling=SamplingParams(logit_bias=((13, 1e9),)))
+    srv.run_until_idle()
+    assert r.finish_reason == "eos"
+    assert len(r.result()) == 5  # exactly min_tokens, then eos
+    assert rush.result() == []   # without min_tokens: immediate eos
