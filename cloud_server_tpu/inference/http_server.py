@@ -265,7 +265,11 @@ class HttpFrontend:
                     return
                 try:
                     handler(self, body)
-                except ValueError as exc:
+                except (ValueError, TypeError, KeyError,
+                        AttributeError) as exc:
+                    # type-confused bodies (e.g. {"prompt": 123},
+                    # non-object messages) surface wherever they break —
+                    # all are client errors, never handler-thread crashes
                     self._json(400, {"error": str(exc)})
                 except RuntimeError as exc:  # scheduler stopped/crashed
                     self._json(503, {"error": str(exc)})
@@ -469,9 +473,18 @@ class HttpFrontend:
             handler.wfile.flush()
             return
 
+        def choice_sampling(k: int):
+            # n > 1 with an explicit seed must still give n DISTINCT
+            # samples: derive per-choice seeds deterministically
+            if n > 1 and sampling is not None and sampling.seed is not None:
+                import dataclasses as _dc
+                return _dc.replace(
+                    sampling, seed=(sampling.seed + k) % (2 ** 32))
+            return sampling
+
         reqs = [self.srv.submit(p, max_new_tokens=max_new,
-                                sampling=sampling)
-                for p in prompts for _ in range(n)]
+                                sampling=choice_sampling(k))
+                for p in prompts for k in range(n)]
         choices = []
         usage_p = usage_c = 0
         for i, r in enumerate(reqs):

@@ -166,8 +166,10 @@ class SamplingParams:
             raise ValueError("min_p must be in [0, 1)")
         if self.repetition_penalty <= 0.0:
             raise ValueError("repetition_penalty must be > 0")
-        if self.seed is not None and not 0 <= self.seed < 2 ** 63:
-            raise ValueError("seed must be in [0, 2**63)")
+        if self.seed is not None and not 0 <= self.seed < 2 ** 32:
+            # rows carry seeds as uint32; accepting wider values would
+            # silently alias seeds differing only in high bits
+            raise ValueError("seed must be in [0, 2**32)")
         # normalise stop to hashable tuples (callers may pass lists)
         stop = tuple(tuple(int(t) for t in s) for s in self.stop)
         if any(len(s) == 0 for s in stop):
