@@ -193,6 +193,10 @@ def _load_requests(path: str, tokenizer) -> list[tuple[list, list]]:
                 continue
             req = json.loads(line)
             if "context_tokens" in req or "continuation_tokens" in req:
+                if "continuation_tokens" not in req:
+                    raise ValueError(
+                        f"{path}:{ln + 1}: context_tokens without "
+                        "continuation_tokens")
                 pairs.append((list(req.get("context_tokens", [])),
                               list(req["continuation_tokens"])))
             else:

@@ -325,7 +325,8 @@ def main(argv=None) -> None:
             spec_drafts=spec,
             prefill_chunk=prefill_chunk, seed=args.seed,
             allocation=args.allocation,
-            draft_params=draft_params, draft_cfg=draft_cfg)
+            draft_params=draft_params, draft_cfg=draft_cfg,
+            tokenizer=tok)  # regex-constrained requests compile vs it
 
     if args.serve_http is not None:
         if args.ngram_draft or (args.draft_config and args.contiguous):

@@ -77,7 +77,7 @@ _STREAM_END = object()
 _SAMPLING_FIELDS = ("temperature", "top_k", "top_p", "min_p",
                     "repetition_penalty", "presence_penalty",
                     "frequency_penalty", "seed", "ignore_eos",
-                    "min_tokens")
+                    "min_tokens", "regex")
 
 
 def _parse_stop(stop, tokenizer) -> tuple[tuple[int, ...], ...]:
@@ -389,10 +389,18 @@ class HttpFrontend:
     # -- OpenAI-compatible endpoints ----------------------------------------
 
     def _openai_sampling(self, body: dict):
-        """(max_tokens, SamplingParams) with OpenAI aliases folded in."""
+        """(max_tokens, SamplingParams) with OpenAI aliases folded in:
+        max_tokens, and response_format {"type": "json_object"} ->
+        the canned bounded-depth JSON grammar."""
         max_new = body.get("max_tokens", body.get("max_new_tokens"))
         if max_new is not None and not isinstance(max_new, int):
             raise ValueError('"max_tokens" must be an int')
+        rf = body.get("response_format")
+        if isinstance(rf, dict) and rf.get("type") == "json_object":
+            from cloud_server_tpu.inference.grammar import \
+                json_object_regex
+            body = dict(body)
+            body.setdefault("regex", json_object_regex())
         return max_new, _parse_sampling(body, self.tokenizer)
 
     def _prompt_variants(self, body: dict) -> list[list[int]]:

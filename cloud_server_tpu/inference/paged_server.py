@@ -69,6 +69,7 @@ from jax import lax
 from cloud_server_tpu.config import InferConfig, ModelConfig
 from cloud_server_tpu.inference import paged_engine, sampling
 from cloud_server_tpu.inference.block_allocator import BlockAllocator
+from cloud_server_tpu.inference.grammar import DEAD as _GDEAD
 from cloud_server_tpu.inference.sampling import (
     SamplingParams, SamplingRows, make_rows, sample_from_probs,
     sample_logits, sample_logits_rows, sampling_probs,
@@ -99,6 +100,23 @@ def _pad_pow2(n: int) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _grammar_mask(grammar, gid, st, eos_id):
+    """Next-state row(s) + allowed-token mask from DFA state(s).
+
+    gid: (B,) or (B, 1); st: (B,) or (B, W). DEAD states allow nothing
+    (their garbage samples are never committed). EOS is allowed exactly
+    at accepting states. THE single mask construction — prefill, decode,
+    and both speculative walks all call this."""
+    tb, ac = grammar
+    idx = jnp.maximum(st, 0)
+    nrow = tb[gid, idx]
+    live_st = st != _GDEAD
+    amask = (nrow != _GDEAD) & live_st[..., None]
+    if eos_id >= 0:
+        amask = amask.at[..., eos_id].set(ac[gid, idx] & live_st)
+    return nrow, amask
+
+
 def _make_cache(pools, lengths, tables):
     return paged_engine.PagedKVCache(
         k=pools["k"], v=pools["v"], lengths=lengths, tables=tables,
@@ -120,6 +138,7 @@ def _split_cache(cache):
 def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
                    slot_ids, prompt_rows, prompt_lens, rng,
                    samp_rows, orig_lens, count_mask,
+                   gid=None, gstate0=None, grammar=None,
                    draft_params=None, *,
                    cfg: ModelConfig, infer_cfg: InferConfig,
                    scatter_prompt: bool, mesh=None, draft_cfg=None,
@@ -175,15 +194,31 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
                 rowi[:, None], oc_cols].add(1, mode="drop")
             pm = pm.at[slot_ids].set(pm_rows, mode="drop")
             oc = oc.at[slot_ids].set(oc_rows, mode="drop")
+    amask = None
+    if grammar is not None:
+        # constrained rows: allowed first tokens from each row's resume
+        # state; EOS allowed only at accepting states
+        nrow, amask = _grammar_mask(grammar, gid, gstate0,
+                                    infer_cfg.eos_token_id)
     if use_rows:
         toks = sample_logits_rows(
             logits, samp_rows, prompt_lens,
             prompt_mask=pm[slot_ids] if has_pen else None,
             out_counts=oc[slot_ids] if has_pen else None,
-            eos_id=infer_cfg.eos_token_id, use_bias=use_bias)
+            eos_id=infer_cfg.eos_token_id, use_bias=use_bias,
+            allowed_mask=amask)
     else:
         toks = sample_logits(logits, rng, infer_cfg)
     lps = _token_logprobs(logits, toks)
+    if grammar is not None:
+        # advance ONLY the rows captured THIS chunk — a multi-chunk job
+        # revisits rows whose sample landed in an earlier chunk, and
+        # rewriting those would reset their already-advanced state
+        g_rows = prompt_rows.shape[0]
+        nstate = nrow[jnp.arange(g_rows), toks]
+        gs = state["gstate"]
+        cap_idx = jnp.where(count_mask, slot_ids, gs.shape[0])
+        new_state["gstate"] = gs.at[cap_idx].set(nstate, mode="drop")
     if has_pen:
         # the captured first token is this slot's first generated token
         oc = oc.at[slot_ids, toks].add(count_mask.astype(jnp.int32),
@@ -216,7 +251,8 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
                           "use_rows", "use_bias"),
          donate_argnums=(1,))
 def _decode_rounds(params, state, lengths, tables, last_token, live,
-                   rng, samp_rows, *, cfg: ModelConfig,
+                   rng, samp_rows, gid=None, grammar=None, *,
+                   cfg: ModelConfig,
                    infer_cfg: InferConfig, n_rounds: int, mesh=None,
                    use_rows: bool = False, use_bias: bool = False):
     """n_rounds plain decode steps (W=1) in one dispatch (lax.scan).
@@ -234,7 +270,7 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
     pm = state.get("prompt_mask")  # None until penalties materialize
 
     def body(carry, rng_t):
-        lengths, last, hist, pools, oc = carry
+        lengths, last, hist, pools, oc, gstate = carry
         # `last` is the committed token at sequence position `lengths`
         # (this round writes its kv there); record it in the history so
         # drafting/multi-turn reads see an unbroken token sequence
@@ -244,6 +280,10 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
         logits, cache = paged_engine.window_forward(
             params, last[:, None], cfg, cache,
             logits_at=jnp.zeros_like(lengths), mesh=mesh)
+        amask = None
+        if grammar is not None:
+            nrow, amask = _grammar_mask(grammar, gid, gstate,
+                                        infer_cfg.eos_token_id)
         if use_rows:
             # the sampled token sits at position lengths + 1 (`last`
             # occupies `lengths`); the admission chunk folds the prompt
@@ -251,25 +291,32 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
             tok = sample_logits_rows(logits, samp_rows, lengths + 1,
                                      prompt_mask=pm, out_counts=oc,
                                      eos_id=infer_cfg.eos_token_id,
-                                     use_bias=use_bias)
+                                     use_bias=use_bias,
+                                     allowed_mask=amask)
             if oc is not None:
                 oc = oc.at[batch_idx, tok].add(live.astype(jnp.int32))
         else:
             tok = sample_logits(logits, rng_t, infer_cfg)
+        if grammar is not None:
+            # sticky DEAD: a dead row (post-EOS scan tail) must never
+            # resurrect through the max(st, 0) clamp
+            gstate = jnp.where(live & (gstate != _GDEAD),
+                               nrow[batch_idx, tok], gstate)
         lp = _token_logprobs(logits, tok)
         tok = jnp.where(live, tok, pad)
         new_len = jnp.where(live, lengths + 1, lengths)
         last = jnp.where(live, tok, last)
-        return ((new_len, last, hist, _split_cache(cache), oc),
+        return ((new_len, last, hist, _split_cache(cache), oc, gstate),
                 (tok, lp, live.astype(jnp.int32)))
 
-    (lengths, last, hist, pools, oc), out = lax.scan(
+    (lengths, last, hist, pools, oc, gstate), out = lax.scan(
         body, (lengths, last_token, state["hist"], state["pools"],
-               state.get("out_counts")),
+               state.get("out_counts"), state["gstate"]),
         jax.random.split(rng, n_rounds))
     new_state = dict(state)
     new_state["pools"] = pools
     new_state["hist"] = hist
+    new_state["gstate"] = gstate
     if oc is not None:
         new_state["out_counts"] = oc
     return new_state, lengths, last, out
@@ -280,7 +327,8 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
                           "mesh", "draft_cfg", "use_rows", "use_bias"),
          donate_argnums=(1,))
 def _spec_rounds(params, state, lengths, tables, last_token, live,
-                 stop_len, rng, samp_rows, draft_params=None, *,
+                 stop_len, rng, samp_rows, gid=None, grammar=None,
+                 draft_params=None, *,
                  cfg: ModelConfig, infer_cfg: InferConfig, n_rounds: int,
                  n_drafts: int, mesh=None, draft_cfg=None,
                  use_rows: bool = False, use_bias: bool = False):
@@ -322,7 +370,7 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
     pm = state.get("prompt_mask")  # None until penalties materialize
 
     def body(carry, rng_t):
-        lengths, last, hist, pools, dpools, oc = carry
+        lengths, last, hist, pools, dpools, oc, gstate = carry
         rng_acc, rng_draft = jax.random.split(rng_t)
         can_commit = live & (lengths < stop_len)
 
@@ -334,16 +382,21 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
         valid = lengths + 1  # committed tokens = [0, lengths] incl. last
         if use_draft:
             def d_step(dc, inp):
-                tok, off, rng_d, cnt = inp
+                tok, off, rng_d, cnt, st_d = inp
                 dcache = _make_cache(dc, lengths + off, tables)
                 dlogits, dcache = paged_engine.window_forward(
                     draft_params, tok[:, None], draft_cfg, dcache,
                     logits_at=jnp.zeros_like(lengths), mesh=mesh)
+                dmask = None
+                if grammar is not None:
+                    _, dmask = _grammar_mask(grammar, gid, st_d,
+                                             infer_cfg.eos_token_id)
                 if use_rows:
                     qp = sampling_probs_rows(
                         dlogits, samp_rows, prompt_mask=pm,
                         out_counts=cnt, positions=lengths + 1 + off,
-                        eos_id=infer_cfg.eos_token_id, use_bias=use_bias)
+                        eos_id=infer_cfg.eos_token_id, use_bias=use_bias,
+                        allowed_mask=dmask)
                 else:
                     qp = sampling_probs(dlogits, infer_cfg)
                 nxt = sample_from_probs(qp, rng_d)
@@ -356,12 +409,18 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
             toks_j, qps = [], []
             tok = last
             run_cnt = oc  # counts as of each draft position (exactness)
+            st_d = gstate
             for step in range(g + 1):
                 rng_draft, rd = jax.random.split(rng_draft)
                 dpools, (nxt, qp) = d_step(
-                    dpools, (tok, jnp.int32(step), rd, run_cnt))
+                    dpools, (tok, jnp.int32(step), rd, run_cnt, st_d))
                 if use_rows and run_cnt is not None and step < g:
                     run_cnt = run_cnt.at[batch_idx, nxt].add(1)
+                if grammar is not None and step < g:
+                    tb, _ = grammar
+                    st_d = jnp.where(
+                        st_d == _GDEAD, st_d,
+                        tb[gid, jnp.maximum(st_d, 0), nxt])
                 tok = nxt
                 toks_j.append(tok)
                 qps.append(qp)
@@ -376,6 +435,20 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
         vlogits, cache = paged_engine.window_forward(
             params, window, cfg, cache, logits_at=None, all_logits=True,
             mesh=mesh)
+        amask_w = None
+        if grammar is not None:
+            # walk the DFA through the drafts: position i's mask comes
+            # from the state AFTER drafts[:i] (exactly the state plain
+            # per-token decoding would be in)
+            tb, _ = grammar
+            sts = [gstate]
+            for jj in range(g):
+                cur = sts[-1]
+                nxt_st = tb[gid, jnp.maximum(cur, 0), drafts[:, jj]]
+                sts.append(jnp.where(cur == _GDEAD, cur, nxt_st))
+            sts_m = jnp.stack(sts, axis=1)  # (B, G+1)
+            _, amask_w = _grammar_mask(grammar, gid[:, None], sts_m,
+                                       infer_cfg.eos_token_id)
         if use_rows and pm is not None:
             # counts at window position i = base + drafts committed
             # before i (position 0 scores the token after `last`, which
@@ -388,12 +461,14 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
             p_probs = sampling_probs_rows(
                 vlogits, samp_rows, prompt_mask=pm, out_counts=counts_w,
                 positions=(lengths + 1)[:, None] + j,
-                eos_id=infer_cfg.eos_token_id, use_bias=use_bias)
+                eos_id=infer_cfg.eos_token_id, use_bias=use_bias,
+                allowed_mask=amask_w)
         elif use_rows:
             p_probs = sampling_probs_rows(
                 vlogits, samp_rows,
                 positions=(lengths + 1)[:, None] + j,
-                eos_id=infer_cfg.eos_token_id, use_bias=use_bias)
+                eos_id=infer_cfg.eos_token_id, use_bias=use_bias,
+                allowed_mask=amask_w)
         else:
             p_probs = sampling_probs(vlogits, infer_cfg)  # (B, G+1, V)
         if use_draft:
@@ -424,18 +499,28 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
             vsz = oc.shape[-1]
             cnt_cols = jnp.where(j < count[:, None], toks, vsz)
             oc = oc.at[batch_idx[:, None], cnt_cols].add(1, mode="drop")
+        if grammar is not None:
+            tb, _ = grammar
+            st = gstate
+            for jj in range(g + 1):
+                step_st = tb[gid, jnp.maximum(st, 0), toks[:, jj]]
+                st = jnp.where((jj < count) & (st != _GDEAD), step_st, st)
+            gstate = st
         last_idx = jnp.maximum(count - 1, 0)
         last2 = jnp.where(count > 0, committed[batch_idx, last_idx], last)
-        return ((new_len, last2, hist, _split_cache(cache), dpools, oc),
+        return ((new_len, last2, hist, _split_cache(cache), dpools, oc,
+                 gstate),
                 (toks, lps, count))
 
-    (lengths, last, hist, pools, dpools, oc), out = lax.scan(
+    (lengths, last, hist, pools, dpools, oc, gstate), out = lax.scan(
         body, (lengths, last_token, state["hist"], state["pools"],
-               state.get("draft_pools"), state.get("out_counts")),
+               state.get("draft_pools"), state.get("out_counts"),
+               state["gstate"]),
         jax.random.split(rng, n_rounds))
     new_state = dict(state)
     new_state["pools"] = pools
     new_state["hist"] = hist
+    new_state["gstate"] = gstate
     if oc is not None:
         new_state["out_counts"] = oc
     if dpools is not None:
@@ -493,7 +578,8 @@ class PagedInferenceServer:
                  prefill_chunk: int = 256, seed: int = 0,
                  mesh=None, tp_axis: str = "tp",
                  allocation: str = "ondemand",
-                 draft_params=None, draft_cfg: ModelConfig | None = None):
+                 draft_params=None, draft_cfg: ModelConfig | None = None,
+                 tokenizer=None):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -590,6 +676,9 @@ class PagedInferenceServer:
         self.state = {
             "pools": _split_cache(cache),
             "hist": jnp.zeros((max_slots, max_context), jnp.int32),
+            # per-slot grammar DFA state (constrained decoding); slots
+            # without a grammar sit at state 0 of the identity grammar
+            "gstate": jnp.zeros((max_slots,), jnp.int32),
         }
         if draft_cfg is not None:
             dcache = paged_engine.init_paged_cache(
@@ -633,6 +722,16 @@ class PagedInferenceServer:
                                    [0] * max_slots)
         self._needs_rows = np.zeros((max_slots,), bool)
         self._has_bias = np.zeros((max_slots,), bool)
+        # regex-constrained decoding: registry of compiled token-DFAs
+        # stacked into one device table; per-slot grammar id + the DFA
+        # state to resume from at (re-)admission
+        self.tokenizer = tokenizer
+        self._grammar_cache = None  # lazy GrammarCache
+        self._patterns: list[str] = []
+        self._pattern_gid: dict[str, int] = {}
+        self._grammar_dev = None  # (tables (Gn,S,V) i32, accept (Gn,S))
+        self._gid = np.zeros((max_slots,), np.int32)
+        self._gstate0 = np.zeros((max_slots,), np.int32)
         self.orig_len = np.zeros((max_slots,), np.int32)
         self._host_rng = np.random.default_rng(seed)
 
@@ -688,6 +787,13 @@ class PagedInferenceServer:
             raise ValueError(
                 f"prompt of {len(prompt)} tokens leaves no room to decode "
                 f"within max_context={self.max_context}")
+        if sampling is not None and sampling.regex is not None:
+            if self.infer_cfg.eos_token_id < 0:
+                raise ValueError(
+                    "regex-constrained decoding needs eos_token_id >= 0 "
+                    "(completion is signalled by EOS at an accepting "
+                    "state)")
+            self._grammar_gid(sampling.regex)  # compile now; 400 here
         req = Request(prompt=list(prompt), max_new_tokens=max_new,
                       stream=stream, sampling=sampling,
                       seed_used=resolve_seed(sampling, self._host_rng,
@@ -720,6 +826,51 @@ class PagedInferenceServer:
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
         return sub
+
+    def _grammar_gid(self, pattern: str) -> int:
+        """Register (compile + restack) a pattern; returns its grammar
+        id. Called from submit() so compilation errors surface on the
+        CLIENT thread as ValueError, never killing the scheduler."""
+        gid = self._pattern_gid.get(pattern)
+        if gid is not None:
+            return gid
+        if self.tokenizer is None:
+            raise ValueError(
+                "regex-constrained requests need a tokenizer: construct "
+                "PagedInferenceServer(..., tokenizer=...)")
+        from cloud_server_tpu.inference import grammar as _g
+        if self._grammar_cache is None:
+            self._grammar_cache = _g.GrammarCache(self.tokenizer,
+                                                  self.cfg.vocab_size)
+        self._grammar_cache.get(pattern)  # compile (raises on bad regex)
+        with self._lock:
+            if pattern not in self._pattern_gid:
+                self._patterns.append(pattern)
+                self._pattern_gid[pattern] = len(self._patterns)
+                self._rebuild_grammar_stack()
+        return self._pattern_gid[pattern]
+
+    def _rebuild_grammar_stack(self) -> None:
+        """(Gn, S_max, V) device stack: gid 0 = the identity grammar
+        (everything allowed, state stays 0), gid i = pattern i-1. Rows
+        past a grammar's state count are DEAD (unreachable)."""
+        from cloud_server_tpu.inference import grammar as _g
+        dfas = [self._grammar_cache.get(pat) for pat in self._patterns]
+        s_max = max([d.num_states for d in dfas] + [1])
+        v = self.cfg.vocab_size
+        tables = np.full((len(dfas) + 1, s_max, v), _g.DEAD, np.int32)
+        accept = np.zeros((len(dfas) + 1, s_max), bool)
+        tables[0] = 0
+        accept[0] = True
+        for i, d in enumerate(dfas, start=1):
+            tables[i, :d.num_states] = d.next_state
+            accept[i, :d.num_states] = d.accept
+        tb, ac = jnp.asarray(tables), jnp.asarray(accept)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            tb = jax.device_put(tb, NamedSharding(self.mesh, P()))
+            ac = jax.device_put(ac, NamedSharding(self.mesh, P()))
+        self._grammar_dev = (tb, ac)
 
     def _ensure_penalty_state(self) -> None:
         """Materialize the (B, V) penalty buffers on first need (one-time
@@ -770,6 +921,8 @@ class PagedInferenceServer:
         self.lengths[slot_id] = 0
         self._needs_rows[slot_id] = False  # don't pin rows-mode dispatch
         self._has_bias[slot_id] = False
+        self._gid[slot_id] = 0
+        self._gstate0[slot_id] = 0
         return slot
 
     def _finish(self, slot_id: int) -> None:
@@ -846,6 +999,17 @@ class PagedInferenceServer:
                 self._has_bias[slot_id] = (
                     req.sampling is not None
                     and bool(req.sampling.logit_bias))
+                if (req.sampling is not None
+                        and req.sampling.regex is not None):
+                    self._gid[slot_id] = self._grammar_gid(
+                        req.sampling.regex)
+                    # continuations resume mid-pattern: replay the
+                    # already-generated tokens host-side
+                    self._gstate0[slot_id] = self._grammar_cache.get(
+                        req.sampling.regex).walk(req.tokens)
+                else:
+                    self._gid[slot_id] = 0
+                    self._gstate0[slot_id] = 0
                 if (req.sampling is not None
                         and req.sampling.needs_penalty_state()):
                     self._ensure_penalty_state()
@@ -925,6 +1089,9 @@ class PagedInferenceServer:
         count_mask = pad_rows(in_range, False)
         use_rows = bool(self._needs_rows[sl].any())
         use_bias = bool(self._has_bias[sl].any())
+        use_grammar = bool((self._gid[sl] > 0).any())
+        gid_g = jnp.asarray(pad_rows(self._gid[sl], 0))
+        gst0_g = jnp.asarray(pad_rows(self._gstate0[sl], 0))
 
         self.state, toks, lps = _prefill_chunk(
             self.params, self.state, jnp.asarray(chunk),
@@ -933,6 +1100,8 @@ class PagedInferenceServer:
             jnp.asarray(prompt_rows), jnp.asarray(prompt_lens, jnp.int32),
             self._next_rng(), jax.tree.map(jnp.asarray, samp_g),
             jnp.asarray(orig_lens, jnp.int32), jnp.asarray(count_mask),
+            gid_g, gst0_g,
+            self._grammar_dev if use_grammar else None,
             self.draft_params,
             cfg=self.cfg, infer_cfg=self.infer_cfg,
             scatter_prompt=(c == 0), mesh=self.mesh,
@@ -1069,10 +1238,14 @@ class PagedInferenceServer:
         samp = jax.tree.map(jnp.asarray, self.samp_rows)
         use_rows = bool((self._needs_rows & live).any())
         use_bias = bool((self._has_bias & live).any())
+        use_grammar = bool(((self._gid > 0) & live).any())
+        gid = jnp.asarray(self._gid)
+        grammar = self._grammar_dev if use_grammar else None
         if self.spec_drafts > 0:
             self.state, lens, last, (toks, lps, counts) = _spec_rounds(
                 self.params, self.state, *args,
                 jnp.asarray(self.stop_len), self._next_rng(), samp,
+                gid, grammar,
                 self.draft_params,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
                 n_drafts=self.spec_drafts, mesh=self.mesh,
@@ -1083,6 +1256,7 @@ class PagedInferenceServer:
         else:
             self.state, lens, last, (toks, lps, counts) = _decode_rounds(
                 self.params, self.state, *args, self._next_rng(), samp,
+                gid, grammar,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
                 mesh=self.mesh, use_rows=use_rows, use_bias=use_bias)
             toks, lps, counts, lens, last = jax.device_get(

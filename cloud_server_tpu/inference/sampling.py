@@ -152,6 +152,11 @@ class SamplingParams:
     logit_bias: tuple[tuple[int, float], ...] = ()
     # suppress EOS until this many tokens have been generated
     min_tokens: int = 0
+    # regex the WHOLE generation must match (constrained decoding; see
+    # inference/grammar.py for the supported syntax and the canned
+    # json_object_regex helper). Paged server only; the server needs a
+    # tokenizer to compile the pattern against.
+    regex: str | None = None
 
     def __post_init__(self):
         if self.temperature is not None and self.temperature < 0:
@@ -185,6 +190,13 @@ class SamplingParams:
         object.__setattr__(self, "logit_bias", bias)
         if not 0 <= self.min_tokens < 2 ** 31:
             raise ValueError("min_tokens must be a small non-negative int")
+        if self.regex is not None and (self.min_tokens > 0
+                                       or self.ignore_eos):
+            # either would force generation past an accept-only DFA
+            # state where ONLY EOS is allowed, leaving no legal token
+            raise ValueError(
+                "regex cannot be combined with min_tokens or ignore_eos "
+                "(the grammar decides when generation may end)")
 
     def needs_device_rows(self, cfg: InferConfig) -> bool:
         """True when this request's DEVICE-side sampling differs from the
@@ -197,7 +209,8 @@ class SamplingParams:
                 or self.needs_penalty_state()
                 or self.seed is not None
                 or bool(self.logit_bias)
-                or self.min_tokens > 0)
+                or self.min_tokens > 0
+                or self.regex is not None)
 
     def needs_penalty_state(self) -> bool:
         """True when sampling this request reads the (B, V) prompt-mask /
@@ -324,8 +337,10 @@ def filtered_logits_rows(logits: jnp.ndarray, rows: SamplingRows, *,
                          prompt_mask: jnp.ndarray | None = None,
                          out_counts: jnp.ndarray | None = None,
                          positions: jnp.ndarray | None = None,
-                         eos_id: int = -1, use_bias: bool = True):
-    """Per-row filter chain over (B, ..., V) logits: logit_bias ->
+                         eos_id: int = -1, use_bias: bool = True,
+                         allowed_mask: jnp.ndarray | None = None):
+    """Per-row filter chain over (B, ..., V) logits: grammar mask ->
+    logit_bias ->
     penalties -> min_tokens EOS suppression -> temperature -> top-k ->
     top-p -> min-p. `positions` (logits.shape[:-1]) are the absolute
     sequence positions being sampled — with `eos_id`, they drive the
@@ -337,6 +352,11 @@ def filtered_logits_rows(logits: jnp.ndarray, rows: SamplingRows, *,
     pre-temperature logits — the greedy-row argmax source)."""
     x = logits.astype(jnp.float32)
     b = x.shape[0]
+    if allowed_mask is not None:
+        # constrained decoding: tokens outside the grammar's allowed set
+        # are impossible — applied FIRST so greedy, penalties, and
+        # top-k/p all operate on the constrained distribution
+        x = jnp.where(allowed_mask, x, NEG_INF)
     if use_bias:
         # logit_bias: build a per-row (B, V) additive table once
         # (padding slots point far out of the vocab and drop),
@@ -392,14 +412,17 @@ def sample_logits_rows(logits: jnp.ndarray, rows: SamplingRows,
                        prompt_mask: jnp.ndarray | None = None,
                        out_counts: jnp.ndarray | None = None,
                        eos_id: int = -1,
-                       use_bias: bool = True) -> jnp.ndarray:
+                       use_bias: bool = True,
+                       allowed_mask: jnp.ndarray | None = None
+                       ) -> jnp.ndarray:
     """Per-row draw: (B, V) logits -> (B,) int32. `positions` (B,) is the
     absolute sequence position being sampled (the fold_in counter and
     the min_tokens generated-count reference)."""
     filt, raw = filtered_logits_rows(logits, rows, prompt_mask=prompt_mask,
                                      out_counts=out_counts,
                                      positions=positions, eos_id=eos_id,
-                                     use_bias=use_bias)
+                                     use_bias=use_bias,
+                                     allowed_mask=allowed_mask)
     keys = _row_keys(rows, positions)
     sampled = jax.vmap(jax.random.categorical)(keys, filt)
     greedy = jnp.argmax(raw, axis=-1)
@@ -412,7 +435,9 @@ def sampling_probs_rows(logits: jnp.ndarray, rows: SamplingRows, *,
                         out_counts: jnp.ndarray | None = None,
                         positions: jnp.ndarray | None = None,
                         eos_id: int = -1,
-                        use_bias: bool = True) -> jnp.ndarray:
+                        use_bias: bool = True,
+                        allowed_mask: jnp.ndarray | None = None
+                        ) -> jnp.ndarray:
     """Rows analogue of `sampling_probs`: the exact per-row distribution
     `sample_logits_rows` draws from, over (B, ..., V) logits (speculative
     verification scores whole windows — pass cumulative `out_counts` and
@@ -421,7 +446,8 @@ def sampling_probs_rows(logits: jnp.ndarray, rows: SamplingRows, *,
     filt, raw = filtered_logits_rows(logits, rows, prompt_mask=prompt_mask,
                                      out_counts=out_counts,
                                      positions=positions, eos_id=eos_id,
-                                     use_bias=use_bias)
+                                     use_bias=use_bias,
+                                     allowed_mask=allowed_mask)
     probs = jax.nn.softmax(filt, axis=-1)
     onehot = jax.nn.one_hot(jnp.argmax(raw, axis=-1), logits.shape[-1],
                             dtype=probs.dtype)

@@ -593,6 +593,10 @@ class InferenceServer:
             # accepting now would enqueue work nothing will ever drain and
             # hang the caller's result() forever.
             raise RuntimeError("server is stopped; not accepting requests")
+        if sampling is not None and sampling.regex is not None:
+            raise ValueError(
+                "regex-constrained decoding is served by the paged "
+                "server (PagedInferenceServer), not the contiguous one")
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         _bucket(len(prompt), self.prompt_buckets)  # raises if too long
