@@ -70,6 +70,18 @@ def _chain_digest(parent: bytes, page_tokens: tuple[int, ...]) -> bytes:
     return h.digest()
 
 
+def _root_for(namespace: str) -> bytes:
+    """Chain root for a KV namespace. Different adapters produce
+    DIFFERENT kv for identical tokens, so their chains must never
+    collide — the namespace (adapter name; "" = base model) salts the
+    root digest, partitioning the cache."""
+    if not namespace:
+        return _ROOT
+    h = hashlib.blake2b(_ROOT, digest_size=16)
+    h.update(b"ns:" + namespace.encode())
+    return h.digest()
+
+
 @dataclasses.dataclass
 class AllocatorStats:
     pages_total: int
@@ -139,18 +151,20 @@ class BlockAllocator:
             self._ref[p] = 1
         return pages
 
-    def lookup_prefix(self, prompt: list[int]) -> tuple[list[int], int]:
+    def lookup_prefix(self, prompt: list[int], namespace: str = ""
+                      ) -> tuple[list[int], int]:
         """Walk the prompt's full pages through the prefix cache.
 
         Returns (shared_pages, shared_len_tokens). Each hit page's
         refcount is bumped — the caller owns one reference per returned
         page and must release() them. At least one prompt token is always
         left un-shared so admission has a position to produce first-token
-        logits from.
+        logits from. `namespace` partitions chains whose KV differs for
+        identical tokens (per-request LoRA adapters).
         """
         ps = self.page_size
         shared: list[int] = []
-        parent = _ROOT
+        parent = _root_for(namespace)
         limit = (len(prompt) - 1) // ps  # full pages, leaving >= 1 token
         for i in range(limit):
             key = (parent, tuple(prompt[i * ps:(i + 1) * ps]))
@@ -167,13 +181,14 @@ class BlockAllocator:
 
     # -- release ------------------------------------------------------------
 
-    def release(self, pages: list[int], tokens: list[int]) -> None:
+    def release(self, pages: list[int], tokens: list[int],
+                namespace: str = "") -> None:
         """Drop one reference per chain page. Pages reaching refcount 0
         become cached (if they are full pages covered by `tokens` — the
         slot's committed prompt + generated ids) or return to the free
-        list (the partial tail)."""
+        list (the partial tail). `namespace` must match the lookup's."""
         ps = self.page_size
-        parent = _ROOT
+        parent = _root_for(namespace)
         for i, page in enumerate(pages):
             self._ref[page] -= 1
             full = (i + 1) * ps <= len(tokens)

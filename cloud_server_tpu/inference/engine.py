@@ -73,7 +73,7 @@ def _kv_dequant(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
-def _mlp_apply(x, lp, cfg: ModelConfig):
+def _mlp_apply(x, lp, cfg: ModelConfig, lora=None):
     """Dense or MoE MLP residual block, chosen by cfg.num_experts.
 
     MoE routing at inference is per-call: prefill routes over the prompt
@@ -81,12 +81,15 @@ def _mlp_apply(x, lp, cfg: ModelConfig):
     differs from training's full-batch routing — exact parity with the
     training forward holds only when nothing drops (generous
     expert_capacity_factor), which is also the sane serving configuration.
+
+    `lora`: per-row multi-adapter deltas (dense MLP only; the server
+    rejects MLP-targeting adapters on MoE bases).
     """
     if cfg.num_experts >= 2:
         from cloud_server_tpu.models import moe
         x, _ = moe.moe_mlp_block(x, lp, cfg)
         return x
-    return transformer.mlp_block(x, lp, cfg)
+    return transformer.mlp_block(x, lp, cfg, lora=lora)
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:

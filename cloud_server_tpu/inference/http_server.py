@@ -236,10 +236,15 @@ class HttpFrontend:
                     self.end_headers()
                     self.wfile.write(body)
                 elif self.path == "/v1/models":
-                    self._json(200, {
-                        "object": "list",
-                        "data": [{"id": front.model_id, "object": "model",
-                                  "owned_by": "cloud-server-tpu"}]})
+                    models = [{"id": front.model_id, "object": "model",
+                               "owned_by": "cloud-server-tpu"}]
+                    adapters = getattr(front.srv, "adapters", None)
+                    if adapters is not None:
+                        models += [{"id": n, "object": "model",
+                                    "owned_by": "cloud-server-tpu",
+                                    "parent": front.model_id}
+                                   for n in adapters.names]
+                    self._json(200, {"object": "list", "data": models})
                 else:
                     self._json(404, {"error": "unknown path"})
 
@@ -336,12 +341,23 @@ class HttpFrontend:
             return self.tokenizer.encode(req["prompt"]) or [0]
         raise ValueError('body needs "prompt" or "tokens"')
 
-    def _submit_streaming(self, tokens, max_new, sampling):
+    def _adapter_kw(self, body: dict) -> dict:
+        """OpenAI routing: a `model` naming a registered LoRA adapter
+        selects it (vLLM convention); the base model id or an unknown
+        name selects the base model."""
+        name = body.get("model")
+        adapters = getattr(self.srv, "adapters", None)
+        if (isinstance(name, str) and adapters is not None
+                and adapters.adapter_id(name) is not None):
+            return {"adapter": name}
+        return {}
+
+    def _submit_streaming(self, tokens, max_new, sampling, **kw):
         """Submit with a queue-backed stream; returns (request, queue).
         The queue yields token ids then _STREAM_END."""
         q: queue.Queue = queue.Queue()
         request = self.srv.submit(tokens, max_new_tokens=max_new,
-                                  stream=q.put, sampling=sampling)
+                                  stream=q.put, sampling=sampling, **kw)
         threading.Thread(  # unblock q.get when generation ends
             target=lambda: (request._done.wait(), q.put(_STREAM_END)),
             daemon=True).start()
@@ -363,7 +379,14 @@ class HttpFrontend:
             raise ValueError('"max_new_tokens" must be an int')
         tokens = self._encode(body)
         sampling = _parse_sampling(body, self.tokenizer)
-        request, q = self._submit_streaming(tokens, max_new, sampling)
+        kw = {}
+        if body.get("adapter") is not None:
+            if getattr(self.srv, "adapters", None) is None:
+                raise ValueError(
+                    "this serving backend does not support adapters")
+            kw["adapter"] = body["adapter"]
+        request, q = self._submit_streaming(tokens, max_new, sampling,
+                                            **kw)
 
         handler.send_response(200)
         handler.send_header("Content-Type", "application/x-ndjson")
@@ -461,8 +484,8 @@ class HttpFrontend:
             if len(prompts) > 1 or n > 1:
                 raise ValueError("streaming supports a single prompt with "
                                  "n=1")
-            request, q = self._submit_streaming(prompts[0], max_new,
-                                                sampling)
+            request, q = self._submit_streaming(
+                prompts[0], max_new, sampling, **self._adapter_kw(body))
             self._sse_head(handler)
             stream = _TextStream(self.tokenizer)
             for tok in self._drain(q):
@@ -490,8 +513,9 @@ class HttpFrontend:
                     sampling, seed=(sampling.seed + k) % (2 ** 32))
             return sampling
 
+        akw = self._adapter_kw(body)
         reqs = [self.srv.submit(p, max_new_tokens=max_new,
-                                sampling=choice_sampling(k))
+                                sampling=choice_sampling(k), **akw)
                 for p in prompts for k in range(n)]
         choices = []
         usage_p = usage_c = 0
@@ -535,7 +559,8 @@ class HttpFrontend:
                 "model": body.get("model", self.model_id)}
 
         if body.get("stream"):
-            request, q = self._submit_streaming(prompt, max_new, sampling)
+            request, q = self._submit_streaming(
+                prompt, max_new, sampling, **self._adapter_kw(body))
             self._sse_head(handler)
             self._sse(handler, {
                 **base, "object": "chat.completion.chunk",
@@ -563,7 +588,8 @@ class HttpFrontend:
             return
 
         req = self.srv.submit(prompt, max_new_tokens=max_new,
-                              sampling=sampling)
+                              sampling=sampling,
+                              **self._adapter_kw(body))
         toks = req.result()
         handler._json(200, {
             **base, "object": "chat.completion",

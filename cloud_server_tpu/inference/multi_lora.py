@@ -1,0 +1,159 @@
+"""Multi-LoRA serving: many adapters live on one base model, selected
+PER REQUEST (cf. vLLM's multi-LoRA, re-built for XLA's static shapes).
+
+Design: all registered adapters stack into one device tensor per target
+— A: (N+1, L, fan_in, r_max), B: (N+1, L, r_max, fan_out) — with row 0
+the NULL adapter (zeros: delta exactly 0) and ranks zero-padded to the
+set's max (padding contributes nothing to A@B). Each slot of the
+continuous batch carries an adapter id; every dispatch gathers its
+per-row (a, b, scale) and the model applies the low-rank delta at the
+same points a merged weight would land
+(`transformer.lora_row_delta` — before rope for wq/wk, on the flattened
+head output for wo, around swiglu for the mlp). Unadapted slots ride
+id 0 and are bit-identical to the base model; mixing adapters in one
+batch costs two thin einsums per target per layer, no recompiles, no
+weight swapping.
+
+Dense targets only (wq/wk/wv/wo/w_gate/w_up/w_down); adapters may
+target different subsets and use different ranks/alphas.
+
+Reference parity note: view-sonic/Cloud-Server @ v0 is an empty tree
+(SURVEY.md); this subsystem is part of the re-scoped build inventory
+(multi-adapter serving).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cloud_server_tpu.config import ModelConfig
+from cloud_server_tpu.models.lora import _DENSE_TARGETS, LoRAConfig
+
+
+class AdapterSet:
+    """Registry + stacked device tensors for per-request LoRA serving.
+
+    `add` returns the adapter id (>= 1; 0 is the null adapter) and
+    restacks the device tensors — a rare, admission-path operation.
+    """
+
+    def __init__(self, model_cfg: ModelConfig, mesh=None):
+        self.model_cfg = model_cfg
+        self.mesh = mesh
+        self._names: list[str] = []
+        self._ids: dict[str, int] = {}
+        self._raw: list[tuple[dict, LoRAConfig]] = []
+        self.stacks: dict | None = None  # {target: {"a","b"}} device
+        self.scales: jnp.ndarray | None = None  # (N+1,) f32
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    @property
+    def names(self) -> list[str]:
+        return list(self._names)
+
+    def adapter_id(self, name: str) -> int | None:
+        return self._ids.get(name)
+
+    def add(self, name: str, lora_params: dict, lora_cfg: LoRAConfig
+            ) -> int:
+        if name in self._ids:
+            raise ValueError(f"adapter {name!r} already registered")
+        bad = set(lora_cfg.targets) - set(_DENSE_TARGETS)
+        if bad:
+            raise ValueError(
+                f"multi-LoRA serving supports dense targets only; "
+                f"{sorted(bad)} are not servable per-request")
+        layers = lora_params.get("layers", lora_params)
+        missing = set(lora_cfg.targets) - set(layers)
+        if missing:
+            raise ValueError(f"adapter {name!r} missing params for "
+                             f"targets {sorted(missing)}")
+        # validate against the MODEL's shapes: a self-consistent but
+        # wrong-sized adapter would otherwise register fine and explode
+        # (or kill the scheduler) at the first dispatch
+        from cloud_server_tpu.models.lora import _split_dims
+        from cloud_server_tpu.models.transformer import param_shapes
+        shapes = param_shapes(self.model_cfg)["layers"]
+        for t in lora_cfg.targets:
+            L = shapes[t][0]
+            _, fan_in, fan_out = _split_dims(t, shapes[t])
+            a = np.asarray(layers[t]["a"])
+            b = np.asarray(layers[t]["b"])
+            want_a = (L, fan_in, lora_cfg.rank)
+            want_b = (L, lora_cfg.rank, fan_out)
+            if a.shape != want_a or b.shape != want_b:
+                raise ValueError(
+                    f"adapter {name!r} target {t!r}: a{a.shape}/"
+                    f"b{b.shape} do not match the base model's "
+                    f"{want_a}/{want_b}")
+        # TRANSACTIONAL: build the new stacks from a candidate list
+        # first — a shape mismatch raises here, leaving the registry
+        # untouched (a half-registered name would pass submit()'s
+        # validation and clamp-gather some other adapter's weights)
+        raw2 = self._raw + [(layers, lora_cfg)]
+        try:
+            stacks, scales = self._build(raw2)
+        except (ValueError, TypeError) as exc:
+            raise ValueError(
+                f"adapter {name!r} has inconsistent shapes: {exc}"
+            ) from exc
+        self._names.append(name)
+        self._ids[name] = len(self._names)  # id 0 = null adapter
+        self._raw = raw2
+        self.stacks = stacks
+        self.scales = scales
+        return self._ids[name]
+
+    def _build(self, raw):
+        r_max = max(cfg.rank for _, cfg in raw)
+        targets = sorted({t for _, cfg in raw for t in cfg.targets})
+        n = len(raw) + 1
+        stacks: dict[str, dict[str, np.ndarray]] = {}
+        for t in targets:
+            # shapes from the first adapter carrying the target
+            ref = next(layers[t] for layers, cfg in raw
+                       if t in cfg.targets)
+            L, fan_in, _ = np.asarray(ref["a"]).shape
+            fan_out = np.asarray(ref["b"]).shape[-1]
+            a = np.zeros((n, L, fan_in, r_max), np.float32)
+            b = np.zeros((n, L, r_max, fan_out), np.float32)
+            for i, (layers, cfg) in enumerate(raw, start=1):
+                if t in cfg.targets:
+                    a[i, :, :, :cfg.rank] = np.asarray(layers[t]["a"],
+                                                       np.float32)
+                    b[i, :, :cfg.rank, :] = np.asarray(layers[t]["b"],
+                                                       np.float32)
+            stacks[t] = {"a": jnp.asarray(a), "b": jnp.asarray(b)}
+        scales = jnp.asarray([1.0] + [cfg.scale for _, cfg in raw],
+                             jnp.float32)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            put = lambda x: jax.device_put(  # noqa: E731
+                x, NamedSharding(self.mesh, P()))
+            stacks = jax.tree.map(put, stacks)
+            scales = put(scales)
+        return stacks, scales
+
+    def device_args(self):
+        """(stacks, scales) to pass into a dispatch (None when empty)."""
+        if not self._raw:
+            return None
+        return (self.stacks, self.scales)
+
+
+def layer_lora(adapters, aid: jnp.ndarray, layer_idx: int):
+    """Per-layer, per-row adapter gather for `transformer.*(lora=...)`.
+
+    adapters: (stacks, scales) from AdapterSet.device_args; aid: (B,)
+    int32 adapter ids. Returns {target: (a (B, fan_in, r),
+    b (B, r, fan_out), scale (B,))}."""
+    if adapters is None:
+        return None
+    stacks, scales = adapters
+    s = scales[aid]
+    return {t: (ab["a"][aid, layer_idx], ab["b"][aid, layer_idx], s)
+            for t, ab in stacks.items()}

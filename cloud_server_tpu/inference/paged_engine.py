@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from cloud_server_tpu.config import ModelConfig
+from cloud_server_tpu.inference import multi_lora
 from cloud_server_tpu.inference.engine import _kv_quant, _mlp_apply
 from cloud_server_tpu.models import transformer
 from cloud_server_tpu.ops import rms_norm, rope_table
@@ -198,7 +199,8 @@ def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
                    cache: PagedKVCache, *, logits_at: jnp.ndarray | None,
                    all_logits: bool = False,
                    pages_per_block: int | None = None,
-                   mesh=None, tp_axis: str = "tp"):
+                   mesh=None, tp_axis: str = "tp",
+                   lora=None, aid=None):
     """Forward W new positions per slot against the paged cache.
 
     Args:
@@ -210,6 +212,10 @@ def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
         needs one sampled position per chunk, never the (B, W, V) tensor.
       all_logits: return (B, W, V) f32 (speculative verification).
         With neither, returns None (interior prefill chunks).
+      lora, aid: multi-adapter serving — (stacks, scales) from
+        inference.multi_lora.AdapterSet.device_args + per-slot adapter
+        ids (B,); each layer gathers its per-row (a, b, scale) and the
+        transformer blocks add the low-rank deltas (id 0 = exact base).
       mesh, tp_axis: tensor-parallel serving. The XLA parts (matmuls,
         gathers, unembed) need nothing — params carry NamedShardings and
         jit propagates them, as in the contiguous engine. Only the
@@ -235,7 +241,10 @@ def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
 
     for layer_idx in range(cfg.num_layers):
         lp = jax.tree.map(lambda p: p[layer_idx], params["layers"])
-        q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin, pos)
+        ll = (None if lora is None
+              else multi_lora.layer_lora(lora, aid, layer_idx))
+        q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin, pos,
+                                            lora=ll)
         cache = _write_window(cache, layer_idx, k, v, pos)
         if use_pallas:
             if mesh is not None and mesh.shape.get(tp_axis, 1) > 1:
@@ -253,8 +262,8 @@ def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
             o = paged_attention_xla(
                 q, cache.k, cache.v, lens_after, cache.tables, layer_idx,
                 k_scale_pool=cache.k_scale, v_scale_pool=cache.v_scale)
-        x = transformer.attention_out(x, o, lp, cfg)
-        x = _mlp_apply(x, lp, cfg)
+        x = transformer.attention_out(x, o, lp, cfg, lora=ll)
+        x = _mlp_apply(x, lp, cfg, lora=ll)
 
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
     if all_logits:

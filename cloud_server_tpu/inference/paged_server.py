@@ -139,6 +139,7 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
                    slot_ids, prompt_rows, prompt_lens, rng,
                    samp_rows, orig_lens, count_mask,
                    gid=None, gstate0=None, grammar=None,
+                   lora=None, aid=None,
                    draft_params=None, *,
                    cfg: ModelConfig, infer_cfg: InferConfig,
                    scatter_prompt: bool, mesh=None, draft_cfg=None,
@@ -166,7 +167,8 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
     """
     cache = _make_cache(state["pools"], g_lens, g_tables)
     logits, cache = paged_engine.window_forward(
-        params, chunk, cfg, cache, logits_at=sample_at, mesh=mesh)
+        params, chunk, cfg, cache, logits_at=sample_at, mesh=mesh,
+        lora=lora, aid=aid)
     new_state = dict(state)
     new_state["pools"] = _split_cache(cache)
 
@@ -251,7 +253,8 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
                           "use_rows", "use_bias"),
          donate_argnums=(1,))
 def _decode_rounds(params, state, lengths, tables, last_token, live,
-                   rng, samp_rows, gid=None, grammar=None, *,
+                   rng, samp_rows, gid=None, grammar=None,
+                   lora=None, aid=None, *,
                    cfg: ModelConfig,
                    infer_cfg: InferConfig, n_rounds: int, mesh=None,
                    use_rows: bool = False, use_bias: bool = False):
@@ -279,7 +282,8 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
         cache = _make_cache(pools, lengths, tables)
         logits, cache = paged_engine.window_forward(
             params, last[:, None], cfg, cache,
-            logits_at=jnp.zeros_like(lengths), mesh=mesh)
+            logits_at=jnp.zeros_like(lengths), mesh=mesh,
+            lora=lora, aid=aid)
         amask = None
         if grammar is not None:
             nrow, amask = _grammar_mask(grammar, gid, gstate,
@@ -328,6 +332,7 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
          donate_argnums=(1,))
 def _spec_rounds(params, state, lengths, tables, last_token, live,
                  stop_len, rng, samp_rows, gid=None, grammar=None,
+                 lora=None, aid=None,
                  draft_params=None, *,
                  cfg: ModelConfig, infer_cfg: InferConfig, n_rounds: int,
                  n_drafts: int, mesh=None, draft_cfg=None,
@@ -434,7 +439,7 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
         cache = _make_cache(pools, lengths, tables)
         vlogits, cache = paged_engine.window_forward(
             params, window, cfg, cache, logits_at=None, all_logits=True,
-            mesh=mesh)
+            mesh=mesh, lora=lora, aid=aid)
         amask_w = None
         if grammar is not None:
             # walk the DFA through the drafts: position i's mask comes
@@ -726,6 +731,10 @@ class PagedInferenceServer:
         # stacked into one device table; per-slot grammar id + the DFA
         # state to resume from at (re-)admission
         self.tokenizer = tokenizer
+        # multi-LoRA serving: stacked adapter set + per-slot adapter ids
+        from cloud_server_tpu.inference.multi_lora import AdapterSet
+        self.adapters = AdapterSet(cfg, mesh=mesh)
+        self._aid = np.zeros((max_slots,), np.int32)
         self._grammar_cache = None  # lazy GrammarCache
         self._patterns: list[str] = []
         self._pattern_gid: dict[str, int] = {}
@@ -773,9 +782,15 @@ class PagedInferenceServer:
 
     def submit(self, prompt: Sequence[int], *,
                max_new_tokens: int | None = None, stream=None,
-               sampling: SamplingParams | None = None) -> Request:
+               sampling: SamplingParams | None = None,
+               adapter: str | None = None) -> Request:
         if self._stop.is_set():
             raise RuntimeError("server is stopped; not accepting requests")
+        if (adapter is not None
+                and self.adapters.adapter_id(adapter) is None):
+            raise ValueError(
+                f"unknown adapter {adapter!r}; registered: "
+                f"{self.adapters.names}")
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         _bucket(len(prompt), self.prompt_buckets)  # raises if too long
@@ -795,7 +810,7 @@ class PagedInferenceServer:
                     "state)")
             self._grammar_gid(sampling.regex)  # compile now; 400 here
         req = Request(prompt=list(prompt), max_new_tokens=max_new,
-                      stream=stream, sampling=sampling,
+                      stream=stream, sampling=sampling, adapter=adapter,
                       seed_used=resolve_seed(sampling, self._host_rng,
                                              self._lock),
                       submit_time=time.perf_counter())
@@ -826,6 +841,20 @@ class PagedInferenceServer:
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
         return sub
+
+    def add_adapter(self, name: str, lora_params: dict,
+                    lora_cfg) -> int:
+        """Register a LoRA adapter for per-request serving; returns its
+        id. Requests select it via submit(..., adapter=name). Restacks
+        the device tensors (one recompile of the dispatch shapes)."""
+        if (self.cfg.num_experts >= 2 and
+                {"w_gate", "w_up", "w_down"} & set(lora_cfg.targets)):
+            raise ValueError(
+                "MLP-targeting adapters cannot be served per-request on "
+                "an MoE base (expert-stacked MLP); use attention targets "
+                "or merged serving")
+        with self._lock:
+            return self.adapters.add(name, lora_params, lora_cfg)
 
     def _grammar_gid(self, pattern: str) -> int:
         """Register (compile + restack) a pattern; returns its grammar
@@ -914,7 +943,8 @@ class PagedInferenceServer:
         retires a slot (finish, preemption, failure) goes through here;
         what happens to the request afterwards is the caller's story."""
         slot = self._slots[slot_id]
-        self.allocator.release(slot.pages, keyed_tokens)
+        self.allocator.release(slot.pages, keyed_tokens,
+                               namespace=slot.req.adapter or "")
         self._slots[slot_id] = None
         self.tables[slot_id, :] = self.allocator.num_pages  # sentinel
         self.active[slot_id] = False
@@ -923,6 +953,7 @@ class PagedInferenceServer:
         self._has_bias[slot_id] = False
         self._gid[slot_id] = 0
         self._gstate0[slot_id] = 0
+        self._aid[slot_id] = 0
         return slot
 
     def _finish(self, slot_id: int) -> None:
@@ -952,7 +983,8 @@ class PagedInferenceServer:
                 req = self._pending[0]
                 prompt = list(req.prompt) + list(req.tokens)
                 remaining = req.max_new_tokens - len(req.tokens)
-                shared, shared_len = self.allocator.lookup_prefix(prompt)
+                shared, shared_len = self.allocator.lookup_prefix(
+                    prompt, namespace=req.adapter or "")
                 if self.allocation == "ondemand":
                     # prompt + one decode window; chains grow per
                     # dispatch in _extend_chains
@@ -962,7 +994,8 @@ class PagedInferenceServer:
                 need = -(-total // self.page_size) - len(shared)
                 fresh = self.allocator.alloc(max(0, need))
                 if fresh is None:
-                    self.allocator.release(shared, prompt[:shared_len])
+                    self.allocator.release(shared, prompt[:shared_len],
+                                           namespace=req.adapter or "")
                     if self.num_active == 0 and not self._jobs:
                         # nothing running will ever free pages: the pool
                         # is simply too small for this request
@@ -1010,6 +1043,9 @@ class PagedInferenceServer:
                 else:
                     self._gid[slot_id] = 0
                     self._gstate0[slot_id] = 0
+                self._aid[slot_id] = (
+                    0 if req.adapter is None
+                    else self.adapters.adapter_id(req.adapter))
                 if (req.sampling is not None
                         and req.sampling.needs_penalty_state()):
                     self._ensure_penalty_state()
@@ -1092,6 +1128,8 @@ class PagedInferenceServer:
         use_grammar = bool((self._gid[sl] > 0).any())
         gid_g = jnp.asarray(pad_rows(self._gid[sl], 0))
         gst0_g = jnp.asarray(pad_rows(self._gstate0[sl], 0))
+        use_lora = bool((self._aid[sl] > 0).any())
+        aid_g = jnp.asarray(pad_rows(self._aid[sl], 0))
 
         self.state, toks, lps = _prefill_chunk(
             self.params, self.state, jnp.asarray(chunk),
@@ -1102,6 +1140,7 @@ class PagedInferenceServer:
             jnp.asarray(orig_lens, jnp.int32), jnp.asarray(count_mask),
             gid_g, gst0_g,
             self._grammar_dev if use_grammar else None,
+            self.adapters.device_args() if use_lora else None, aid_g,
             self.draft_params,
             cfg=self.cfg, infer_cfg=self.infer_cfg,
             scatter_prompt=(c == 0), mesh=self.mesh,
@@ -1241,11 +1280,14 @@ class PagedInferenceServer:
         use_grammar = bool(((self._gid > 0) & live).any())
         gid = jnp.asarray(self._gid)
         grammar = self._grammar_dev if use_grammar else None
+        use_lora = bool(((self._aid > 0) & live).any())
+        lora = self.adapters.device_args() if use_lora else None
+        aid = jnp.asarray(self._aid)
         if self.spec_drafts > 0:
             self.state, lens, last, (toks, lps, counts) = _spec_rounds(
                 self.params, self.state, *args,
                 jnp.asarray(self.stop_len), self._next_rng(), samp,
-                gid, grammar,
+                gid, grammar, lora, aid,
                 self.draft_params,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
                 n_drafts=self.spec_drafts, mesh=self.mesh,
@@ -1256,7 +1298,7 @@ class PagedInferenceServer:
         else:
             self.state, lens, last, (toks, lps, counts) = _decode_rounds(
                 self.params, self.state, *args, self._next_rng(), samp,
-                gid, grammar,
+                gid, grammar, lora, aid,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
                 mesh=self.mesh, use_rows=use_rows, use_bias=use_bias)
             toks, lps, counts, lens, last = jax.device_get(

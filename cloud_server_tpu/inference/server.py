@@ -376,6 +376,8 @@ class Request:
     # own, or one drawn from the server's host RNG at submit) — stable
     # across preemption/re-admission
     seed_used: int = 0
+    # multi-LoRA serving: registered adapter name (paged server)
+    adapter: str | None = None
     tokens: list[int] = dataclasses.field(default_factory=list)
     # log P(token) under the model's raw (pre-filter) distribution,
     # aligned with `tokens`

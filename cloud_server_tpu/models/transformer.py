@@ -115,22 +115,48 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
 # Forward
 # ---------------------------------------------------------------------------
 
-def attention_qkv(x, lp, cfg: ModelConfig, cos, sin, positions=None):
+def lora_row_delta(h, ab) -> jnp.ndarray:
+    """Per-ROW low-rank delta for multi-adapter serving: each batch row
+    carries its own (A, B) pair (gathered from a stacked adapter set by
+    the row's adapter id). h: (B, S, Din); ab = (a (B, Din, r),
+    b (B, r, Dout), scale (B,)) -> (B, S, Dout)."""
+    a, b, scale = ab
+    z = jnp.einsum("bsd,bdr->bsr", h, a.astype(h.dtype))
+    d = jnp.einsum("bsr,bro->bso", z, b.astype(h.dtype))
+    return d * scale[:, None, None].astype(h.dtype)
+
+
+def attention_qkv(x, lp, cfg: ModelConfig, cos, sin, positions=None,
+                  lora=None):
     """Pre-norm + q/k/v projection + rope. Single source of truth for the
     attention input path — the inference engine's prefill/decode reuse this
-    so cached inference can never drift numerically from training."""
+    so cached inference can never drift numerically from training.
+
+    `lora` (serving only): {target: (a, b, scale)} per-row adapters —
+    deltas land BEFORE rope, exactly where a merged weight would."""
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(cfg.dtype))
     k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(cfg.dtype))
     v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(cfg.dtype))
+    if lora:
+        if "wq" in lora:
+            q = q + lora_row_delta(h, lora["wq"]).reshape(q.shape)
+        if "wk" in lora:
+            k = k + lora_row_delta(h, lora["wk"]).reshape(k.shape)
+        if "wv" in lora:
+            v = v + lora_row_delta(h, lora["wv"]).reshape(v.shape)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     return q, k, v
 
 
-def attention_out(x, o, lp, cfg: ModelConfig):
+def attention_out(x, o, lp, cfg: ModelConfig, lora=None):
     """Output projection + residual add (the attention block's second half)."""
-    return x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+    y = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cfg.dtype))
+    if lora and "wo" in lora:
+        b_, s_ = o.shape[:2]
+        y = y + lora_row_delta(o.reshape(b_, s_, -1), lora["wo"])
+    return x + y
 
 
 def _attention_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn,
@@ -140,12 +166,20 @@ def _attention_block(x, lp, cfg: ModelConfig, cos, sin, attn_fn,
     return attention_out(x, o, lp, cfg)
 
 
-def mlp_block(x, lp, cfg: ModelConfig):
+def mlp_block(x, lp, cfg: ModelConfig, lora=None):
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(cfg.dtype))
     up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(cfg.dtype))
-    return x + jnp.einsum("bsf,fd->bsd", swiglu(gate, up),
-                          lp["w_down"].astype(cfg.dtype))
+    if lora:
+        if "w_gate" in lora:
+            gate = gate + lora_row_delta(h, lora["w_gate"])
+        if "w_up" in lora:
+            up = up + lora_row_delta(h, lora["w_up"])
+    act = swiglu(gate, up)
+    down = jnp.einsum("bsf,fd->bsd", act, lp["w_down"].astype(cfg.dtype))
+    if lora and "w_down" in lora:
+        down = down + lora_row_delta(act, lora["w_down"])
+    return x + down
 
 
 def _unembed_head(params: Params, cfg: ModelConfig) -> jnp.ndarray:
