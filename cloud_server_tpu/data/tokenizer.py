@@ -84,6 +84,10 @@ class HFTokenizer:
         self.eos_id = _tid("</s>", "<eos>", "<|end_of_text|>",
                            "<|endoftext|>", "[SEP]")
         self.pad_id = _tid("<pad>", "[PAD]")
+        # provenance matters downstream: grammar.token_bytes bans
+        # DECLARED specials only — a fallback pad (eos, else 0) must not
+        # make a real vocab id unspellable under a constraint
+        self.pad_is_declared = self.pad_id is not None
         if self.pad_id is None:  # fall back to EOS, the common convention
             self.pad_id = self.eos_id if self.eos_id is not None else 0
 

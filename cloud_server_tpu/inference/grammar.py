@@ -40,6 +40,7 @@ Reference parity note: view-sonic/Cloud-Server @ v0 is an empty tree
 from __future__ import annotations
 
 import functools
+import re as _pyre
 from typing import Sequence
 
 import numpy as np
@@ -468,6 +469,50 @@ def _gpt2_unicode_to_byte() -> dict[str, int]:
     return {chr(c): b for b, c in zip(bs, cs)}
 
 
+def _declared_special_ids(tokenizer, inner) -> set[int] | None:
+    """Special-token ids from the tokenizer's OWN declaration: the
+    added-token registry's `special` flags plus the wrapper's resolved
+    bos/eos/pad. Returns None when the tokenizer declares nothing, in
+    which case the caller falls back to a string-shape heuristic — a
+    heuristic alone would misclassify real BPE merges like '[]', '[0]'
+    or '<div>' and make them unreachable under a grammar."""
+    getter = getattr(inner, "get_added_tokens_decoder", None)
+    if getter is None:
+        asi = getattr(tokenizer, "all_special_ids", None)
+        return {int(i) for i in asi} if asi else None
+    try:
+        ids = {int(tid) for tid, tok in getter().items()
+               if getattr(tok, "special", True)}
+    except Exception:  # pragma: no cover — tokenizers API drift
+        return None
+    # base-vocab specials the wrapper resolved at construction (some
+    # tokenizer.json files bake bos/eos into the vocab, not added)
+    for name in ("bos_id", "eos_id"):
+        v = getattr(tokenizer, name, None)
+        if isinstance(v, int) and v >= 0:
+            ids.add(v)
+    # pad only when the tokenizer actually DECLARED one — the wrapper's
+    # fallback (eos, else 0) would otherwise ban real vocab id 0
+    pad = getattr(tokenizer, "pad_id", None)
+    if (getattr(tokenizer, "pad_is_declared", True)
+            and isinstance(pad, int) and pad >= 0):
+        ids.add(pad)
+    return ids
+
+
+_BYTE_FALLBACK = _pyre.compile(r"^<0x([0-9A-Fa-f]{2})>$")
+
+
+def _has_byte_fallback(inner) -> bool:
+    """True when the vocab carries the sentencepiece byte-fallback
+    convention: ALL 256 '<0xHH>' tokens present. A partial set (e.g. a
+    BPE merge that happens to spell '<0x0A>') stays literal text."""
+    t2i = getattr(inner, "token_to_id", None)
+    if t2i is None:
+        return False
+    return all(t2i(f"<0x{b:02X}>") is not None for b in range(256))
+
+
 def token_bytes(tokenizer, vocab_size: int) -> list[bytes | None]:
     """Per-token UTF-8 byte strings; None = unspellable (specials, ids
     past the tokenizer). Exact for ByteTokenizer; HF fast tokenizers go
@@ -476,11 +521,23 @@ def token_bytes(tokenizer, vocab_size: int) -> list[bytes | None]:
     inner = getattr(tokenizer, "_tok", None)
     if inner is not None and hasattr(inner, "id_to_token"):
         g2b = _gpt2_unicode_to_byte()
+        specials = _declared_special_ids(tokenizer, inner)
+        byte_fb = _has_byte_fallback(inner)
         for i in range(min(vocab_size, tokenizer.vocab_size)):
             s = inner.id_to_token(i)
-            if s is None or (s.startswith("<") and s.endswith(">")) or (
+            if s is None:
+                continue
+            if specials is not None:
+                if i in specials:
+                    continue  # never valid inside a constraint
+            elif (s.startswith("<") and s.endswith(">")) or (
                     s.startswith("[") and s.endswith("]")):
-                continue  # specials are never valid inside a constraint
+                continue  # undeclared tokenizer: shape heuristic
+            if byte_fb:
+                m = _BYTE_FALLBACK.match(s)
+                if m:  # sentencepiece byte fallback: '<0x0A>' IS \n
+                    out[i] = bytes([int(m.group(1), 16)])
+                    continue
             if all(ch in g2b for ch in s):  # byte-level BPE alphabet
                 out[i] = bytes(g2b[ch] for ch in s)
             else:  # sentencepiece-style: ▁ marks a leading space

@@ -105,12 +105,16 @@ def _grammar_mask(grammar, gid, st, eos_id):
 
     gid: (B,) or (B, 1); st: (B,) or (B, W). DEAD states allow nothing
     (their garbage samples are never committed). EOS is allowed exactly
-    at accepting states. THE single mask construction — prefill, decode,
-    and both speculative walks all call this."""
+    at accepting states. gid 0 (the identity grammar) is unconditionally
+    live at state 0 — a stale device state left by a slot's previous
+    constrained occupant must never mask an unconstrained request. THE
+    single mask construction — prefill, decode, and both speculative
+    walks all call this."""
     tb, ac = grammar
-    idx = jnp.maximum(st, 0)
+    ident = gid == 0
+    idx = jnp.where(ident, 0, jnp.maximum(st, 0))
     nrow = tb[gid, idx]
-    live_st = st != _GDEAD
+    live_st = (st != _GDEAD) | ident
     amask = (nrow != _GDEAD) & live_st[..., None]
     if eos_id >= 0:
         amask = amask.at[..., eos_id].set(ac[gid, idx] & live_st)
@@ -212,12 +216,20 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
     else:
         toks = sample_logits(logits, rng, infer_cfg)
     lps = _token_logprobs(logits, toks)
-    if grammar is not None:
+    if gstate0 is not None:
         # advance ONLY the rows captured THIS chunk — a multi-chunk job
         # revisits rows whose sample landed in an earlier chunk, and
-        # rewriting those would reset their already-advanced state
-        g_rows = prompt_rows.shape[0]
-        nstate = nrow[jnp.arange(g_rows), toks]
+        # rewriting those would reset their already-advanced state.
+        # Grammar-free groups still SCATTER (their gstate0, i.e. 0):
+        # admission must overwrite whatever DFA state the slot's
+        # previous occupant left behind — DEAD is sticky, and a stale
+        # DEAD row would mask every token for the new request the
+        # moment any other live slot is constrained.
+        if grammar is not None:
+            g_rows = prompt_rows.shape[0]
+            nstate = nrow[jnp.arange(g_rows), toks]
+        else:
+            nstate = gstate0
         gs = state["gstate"]
         cap_idx = jnp.where(count_mask, slot_ids, gs.shape[0])
         new_state["gstate"] = gs.at[cap_idx].set(nstate, mode="drop")

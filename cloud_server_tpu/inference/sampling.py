@@ -322,15 +322,20 @@ def penalised_logits(logits: jnp.ndarray, rows: SamplingRows,
                      out_counts: jnp.ndarray) -> jnp.ndarray:
     """Presence/frequency penalties over generated-token counts
     (`out_counts`, broadcastable to `logits`) and HF-style repetition
-    penalty over prompt-or-generated (`prompt_mask` (B, V) bool)."""
+    penalty over prompt-or-generated (`prompt_mask` (B, V) bool).
+
+    Order matches vLLM's apply_penalties: the repetition penalty
+    divides/multiplies the RAW logits, then presence/frequency subtract
+    — so a pres/freq sign flip can never invert the repetition
+    penalty's direction."""
     x = logits.astype(jnp.float32)
     counts = out_counts.astype(jnp.float32)
     pm = prompt_mask if prompt_mask.ndim == x.ndim else prompt_mask[:, None]
-    x = (x - _expand(rows.pres, x) * (counts > 0)
-         - _expand(rows.freq, x) * counts)
     seen = pm | (out_counts > 0)
     rep = _expand(rows.rep, x)
-    return jnp.where(seen, jnp.where(x > 0, x / rep, x * rep), x)
+    x = jnp.where(seen, jnp.where(x > 0, x / rep, x * rep), x)
+    return (x - _expand(rows.pres, x) * (counts > 0)
+            - _expand(rows.freq, x) * counts)
 
 
 def filtered_logits_rows(logits: jnp.ndarray, rows: SamplingRows, *,

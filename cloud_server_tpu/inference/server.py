@@ -459,8 +459,13 @@ def emit_token(req: Request, token: int, logprob: float | None,
             if len(req.tokens) >= ls and req.tokens[-ls:] == list(s):
                 del req.tokens[-ls:]
                 del req.emit_times[-ls:]
-                if req.logprobs:
-                    del req.logprobs[-ls:]
+                # logprobs may cover only a PREFIX of tokens (the
+                # logprob=None path appends nothing): drop exactly the
+                # entries past the kept-token count — a blanket [-ls:]
+                # would strip logprobs belonging to kept tokens
+                drop = len(req.logprobs) - len(req.tokens)
+                if drop > 0:
+                    del req.logprobs[-drop:]
                 req.finish_reason = "stop"
                 return True
     if req.stream is not None:

@@ -91,6 +91,51 @@ def test_token_dfa_lift_byte_tokenizer():
     assert (tdfa.next_state[:, 299] == grammar.DEAD).all()  # out of tok
 
 
+def test_token_bytes_specials_from_declaration(tmp_path):
+    """Specials come from the tokenizer's DECLARED added-token flags,
+    not a string-shape heuristic: real vocab entries spelled '<div>' or
+    '[]' stay spellable under a grammar; declared specials never are."""
+    pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer, models, trainers
+    from cloud_server_tpu.data.tokenizer import HFTokenizer
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    trainer = trainers.BpeTrainer(
+        vocab_size=300, special_tokens=["<unk>", "<s>", "</s>"])
+    tok.train_from_iterator(["div class abc 0123"] * 20, trainer)
+    tok.add_tokens(["<div>", "[]"])  # plain added tokens, NOT special
+    path = tmp_path / "tokenizer.json"
+    tok.save(str(path))
+    hf = HFTokenizer(str(path))
+    tb = grammar.token_bytes(hf, hf.vocab_size)
+    assert tb[tok.token_to_id("<div>")] == b"<div>"
+    assert tb[tok.token_to_id("[]")] == b"[]"
+    for name in ("<s>", "</s>", "<unk>"):
+        assert tb[tok.token_to_id(name)] is None
+    # no declared pad -> wrapper falls back to eos; real vocab id 0
+    # (here '<unk>'-adjacent base ids) must NOT be banned by fallback
+    assert hf.pad_is_declared is False
+
+
+def test_token_bytes_sentencepiece_byte_fallback(tmp_path):
+    """With the FULL '<0x00>'..'<0xFF>' convention present, fallback
+    tokens decode to their raw byte — not their literal spelling (which
+    would let a grammar emit bytes that violate the constraint)."""
+    pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer, models, trainers
+    from cloud_server_tpu.data.tokenizer import HFTokenizer
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    trainer = trainers.BpeTrainer(
+        vocab_size=300, special_tokens=["<unk>", "<s>", "</s>"])
+    tok.train_from_iterator(["plain words here"] * 20, trainer)
+    tok.add_tokens([f"<0x{b:02X}>" for b in range(256)])
+    path = tmp_path / "tokenizer.json"
+    tok.save(str(path))
+    hf = HFTokenizer(str(path))
+    tb = grammar.token_bytes(hf, hf.vocab_size)
+    assert tb[tok.token_to_id("<0x0A>")] == b"\n"
+    assert tb[tok.token_to_id("<0xFF>")] == b"\xff"
+
+
 # ---------------------------------------------------------------------------
 # constrained generation through the paged server
 # ---------------------------------------------------------------------------
@@ -173,6 +218,32 @@ def test_constrained_survives_preemption(params):
     srv.run_until_idle()
     del crowd
     assert _valid(r"[0-9]{8,10}", con.result())
+
+
+def test_slot_reuse_after_constrained_is_clean(params):
+    """A constrained request that finishes via EOS leaves its slot's
+    device DFA state DEAD (the EOS column is DEAD and DEAD is sticky).
+    An UNCONSTRAINED request later admitted into that slot through a
+    grammar-free admission group must not inherit it — even while
+    another live slot is constrained (regression: the stale DEAD row
+    masked every token for the reused slot, committing garbage)."""
+    ref = PagedInferenceServer(params, CFG, ICFG, **SRV_KW)
+    want = ref.generate([TOK.encode("hello")], max_new_tokens=8)[0]
+    srv = PagedInferenceServer(params, CFG, ICFG, **SRV_KW)
+    # [0-9]{2}: after two digits EOS is the ONLY allowed token, so the
+    # greedy finish is via EOS and the slot's gstate lands on DEAD
+    con = srv.submit(TOK.encode("n:"), max_new_tokens=8,
+                     sampling=SamplingParams(regex=r"[0-9]{2}"))
+    srv.run_until_idle()
+    assert con.finish_reason == "eos"  # precondition: DEAD was written
+    free = srv.submit(TOK.encode("hello"), max_new_tokens=8)
+    while srv._jobs or srv.num_pending:  # admit via a grammar-free group
+        srv.step()
+    con2 = srv.submit(TOK.encode("m:"), max_new_tokens=8,
+                      sampling=SamplingParams(regex=r"[0-9]{2}"))
+    srv.run_until_idle()
+    assert free.result() == want
+    assert _valid(r"[0-9]{2}", con2.result())
 
 
 def test_constrained_validation(params):

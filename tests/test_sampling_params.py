@@ -97,8 +97,9 @@ def test_penalties_adjust_logits():
     raw = np.asarray(raw[0])
     # token 1: prompt-only -> repetition penalty on negative: * 2
     assert raw[1] == pytest.approx(-2.0)
-    # token 2: generated 3x -> 2.0 - .25 - 1.5 = 0.25, then /2 = 0.125
-    assert raw[2] == pytest.approx(0.125)
+    # token 2: generated 3x -> vLLM order: 2.0 / 2 = 1.0 (repetition
+    # first, on the raw logit), then - .25 - 1.5 = -0.75
+    assert raw[2] == pytest.approx(-0.75)
     # tokens 0, 3: untouched
     assert raw[0] == pytest.approx(1.0)
     assert raw[3] == pytest.approx(0.5)
@@ -132,6 +133,18 @@ def test_stop_sequence_truncates():
     assert req.finish_reason == "stop"
     assert req.tokens == [5]          # the match is removed
     assert len(req.logprobs) == 1
+
+
+def test_stop_truncation_keeps_partial_logprobs_aligned():
+    """When logprobs cover only a prefix of the tokens (logprob=None
+    path), a stop-sequence match must not strip entries belonging to
+    KEPT tokens."""
+    req = _req(sampling=SamplingParams(stop=((7, 8),)))
+    assert not emit_token(req, 5, -1.0, GREEDY)   # has a logprob
+    assert not emit_token(req, 7, None, GREEDY)   # no logprob recorded
+    assert emit_token(req, 8, None, GREEDY)
+    assert req.tokens == [5]
+    assert req.logprobs == [-1.0]  # the kept token's entry survives
 
 
 def test_ignore_eos_runs_to_length():
