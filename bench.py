@@ -86,22 +86,39 @@ def sync_device(x) -> None:
     jax.device_get(jax.tree.leaves(x)[0].ravel()[0])
 
 
-def diff_time_scan(make_fn, args, n1: int, n2: int, reps: int = 2) -> float:
+def diff_time_scan_multi(make_fn, args, n1: int, n2: int, *,
+                         reps: int = 2, n_meas: int = 1) -> list[float]:
     """Per-iteration seconds via the two-length differential: the
     tunnel's ~100 ms fixed dispatch+sync cost cancels in
     (t(n2) - t(n1)) / (n2 - n1). Best-of-`reps` per length; pick n2 so
-    (n2 - n1) x per-iter >> the fixed cost's variance (~30 ms)."""
-    best = {}
+    (n2 - n1) x per-iter >> the fixed cost's variance (~30 ms).
+
+    Returns `n_meas` INDEPENDENT differential estimates from ONE pair of
+    compiled fns (compilation through the remote tunnel costs tens of
+    seconds — the repeats that establish run-to-run spread must not pay
+    it again). r3 learned why repeats matter: a single differential
+    produced 12.0 us for a read that the HBM roofline bounds at ~40 us."""
+    fns = {}
     for n in (n1, n2):
         fn = jax.jit(make_fn(n))
         sync_device(fn(*args))  # compile + warm
-        b = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            sync_device(fn(*args))
-            b = min(b, time.perf_counter() - t0)
-        best[n] = b
-    return (best[n2] - best[n1]) / (n2 - n1)
+        fns[n] = fn
+    out = []
+    for _ in range(n_meas):
+        best = {}
+        for n in (n1, n2):
+            b = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                sync_device(fns[n](*args))
+                b = min(b, time.perf_counter() - t0)
+            best[n] = b
+        out.append((best[n2] - best[n1]) / (n2 - n1))
+    return out
+
+
+def diff_time_scan(make_fn, args, n1: int, n2: int, reps: int = 2) -> float:
+    return diff_time_scan_multi(make_fn, args, n1, n2, reps=reps)[0]
 
 
 def _sync(state, metrics) -> float:
@@ -270,15 +287,16 @@ def serving_bench():
         srv.stop()
 
     def run_paged(tag, params, kv, *, spec=0, prompts=plain_prompts,
-                  icfg=None):
+                  icfg=None, sampling=None):
         cfg = dataclasses.replace(base, kv_cache_dtype=kv,
                                   decode_attention_impl="pallas")
         srv = PagedInferenceServer(
             params, cfg, icfg or infer_cfg, max_slots=8, max_context=1024,
             page_size=128, prefill_chunk=256, decode_chunk=chunk,
             spec_drafts=spec, prompt_buckets=[64, 128])
-        for p in prompts:
-            srv.submit(p, max_new_tokens=880)
+        for i, p in enumerate(prompts):
+            srv.submit(p, max_new_tokens=880,
+                       sampling=sampling(i) if sampling else None)
         for _ in range(3):
             srv.step()
         before = srv.tokens_emitted
@@ -299,24 +317,29 @@ def serving_bench():
     run_contiguous("decode_tok_s_xla_int8", params_int8, "model")
     run_contiguous("decode_tok_s_xla_bf16_kvint8", params_bf16, "int8")
     run_paged("decode_tok_s_pallas_bf16", params_bf16, "model")
+    # A/B for the per-request-sampling hot path: SamplingParams(seed=i)
+    # forces the SamplingRows decode dispatch with math identical to the
+    # server default (temperature 1.0) — the tok/s delta vs the row
+    # above IS the rows-mode overhead (r4 shipped the rows threading
+    # with a correctness test but no on-chip timing)
+    from cloud_server_tpu.inference.sampling import SamplingParams
+    run_paged("decode_tok_s_pallas_rows_on", params_bf16, "model",
+              sampling=lambda i: SamplingParams(seed=1000 + i))
     run_paged("decode_tok_s_pallas_bf16_kvint8", params_bf16, "int8")
     # speculative: greedy so acceptance reflects the model, not sampling
     run_paged("decode_tok_s_pallas_spec_repeat", params_bf16, "model",
               spec=3, prompts=rep_prompts, icfg=greedy)
     run_paged("decode_tok_s_pallas_spec_random", params_bf16, "model",
               spec=3, prompts=plain_prompts, icfg=greedy)
-
-    # auxiliary sections: a transient remote-compile tunnel drop must not
-    # void the headline rows already measured
-    for section in (lambda: _admission_churn_bench(params_bf16, base,
-                                                   infer_cfg),
-                    _trained_spec_bench,
-                    _longcontext_attention_bench):
-        try:
-            out.update(section())
-        except Exception as exc:  # noqa: BLE001 — tunnel flakes happen
-            print(f"[serving_bench] section skipped after error: {exc!r}",
-                  flush=True)
+    # churn rides in this section (reuses the params already on device)
+    # — guarded so a churn-time tunnel flake cannot void the headline
+    # decode rows measured above
+    try:
+        out.update(_admission_churn_bench(params_bf16, base, infer_cfg))
+    except Exception as exc:  # noqa: BLE001
+        print(f"[serving_bench] churn skipped after error: {exc!r}",
+              flush=True)
+        out["churn_error"] = repr(exc)[:160]
     return out
 
 
@@ -530,84 +553,204 @@ def _trained_spec_bench():
     return out
 
 
-def _longcontext_attention_bench():
-    """S=8192 decode attention, kernel vs XLA dense — the shape where the
-    r2 contiguous kernel lost 3x. Differential scan timing (tunnel-free);
-    also reports the S=1024 pair for provenance."""
-    import numpy as np
+def _hbm_bps() -> float:
+    """This device's HBM bandwidth for the physical-sanity filter.
+    Known parts only; an UNKNOWN device kind returns 0.0, which
+    DISABLES rejection (floor 0) — on a part we can't bound, clamping
+    to the wrong roofline would fabricate numbers instead of
+    measuring them."""
+    kind = jax.devices()[0].device_kind.lower()
+    for key, bps in (("v5 lite", 0.819e12), ("v5e", 0.819e12),
+                     ("v5p", 2.765e12), ("v5", 1.228e12),
+                     ("v6e", 1.638e12), ("trillium", 1.638e12),
+                     ("v4", 1.228e12), ("v3", 0.9e12)):
+        if key in kind:
+            return bps
+    return 0.0
+
+
+def _robust_attn_us(make_body, q, bytes_read: float,
+                    n_meas: int = 5) -> tuple[float, float, int]:
+    """(median_us, relative spread, n_rejected) over `n_meas`
+    differential estimates, REJECTING the physically impossible: an
+    estimate implying more than ~1.1x the HBM roofline's bandwidth for
+    `bytes_read` is a harness artifact, not a measurement (r3 published
+    12.0 us for a 33.6 MB read — 2.8 TB/s on a 0.8 TB/s part — and it
+    rode into the round's headline). Spread is (max-min)/median of the
+    survivors; callers treat spread > 0.5 as 'do not quote'."""
     from jax import lax
+
+    def scan_of(n):
+        def fn(q0):
+            def f(qq, _):
+                return make_body(qq).astype(qq.dtype), None
+            return lax.scan(f, q0, None, length=n)[0]
+        return fn
+
+    # 100/1600: at ~50-500 us/iter the 1500-iter delta dwarfs the
+    # tunnel's fixed-cost variance (negative estimates otherwise)
+    ests = diff_time_scan_multi(scan_of, (q,), 100, 1600, reps=3,
+                                n_meas=n_meas)
+    bps = _hbm_bps()
+    floor_s = bytes_read / (bps * 1.1) if bps > 0 else 0.0
+    ok = [e for e in ests if e >= floor_s]
+    rejected = len(ests) - len(ok)
+    if not ok:  # all impossible: report the floor-clamped median, loudly
+        med = sorted(ests)[len(ests) // 2]
+        return max(med, floor_s) * 1e6, 999.0, rejected
+    med = sorted(ok)[len(ok) // 2]
+    spread = (max(ok) - min(ok)) / med if med > 0 else 999.0
+    return med * 1e6, spread, rejected
+
+
+def _longcontext_attention_bench():
+    """Decode attention, paged kernel vs XLA dense, differential scan
+    timing (tunnel-free) with roofline-rejected repeats (see
+    _robust_attn_us). Three cases:
+      * S=1024 full-length (B=8) — XLA's best shape, near roofline;
+        parity expected (r3/r4 history: see docs/serving.md).
+      * S=8192 full-length (B=2) — long-context decode.
+      * RAGGED S=1024 (B=8, true lens 128..1024) — the shape the paged
+        kernel exists for: it reads only each row's true pages while
+        dense attention streams the full padded (B, S) KV. This is the
+        serving steady state (requests at mixed depths), and the row the
+        kernel's length-bounded claim is judged by."""
+    import numpy as np
 
     from cloud_server_tpu.ops.attention import causal_attention
     from cloud_server_tpu.ops.paged_attention import paged_attention
 
     out = {}
-    for S, b in ((1024, 8), (8192, 2)):
-        KH = H = 16
-        D, PS = 64, 128
-        mp = S // PS
-        num_pages = b * mp
-        ks = jax.random.split(jax.random.key(1), 4)
-        k_pool = jax.random.normal(ks[0], (1, num_pages, KH, D, PS),
-                                   jnp.bfloat16)
-        v_pool = jax.random.normal(ks[1], (1, num_pages, KH, D, PS),
-                                   jnp.bfloat16)
-        tables = jnp.asarray(
-            np.random.RandomState(0).permutation(num_pages).reshape(b, mp),
-            jnp.int32)
-        k_cat = jax.random.normal(ks[2], (b, S, KH, D), jnp.bfloat16)
-        v_cat = jax.random.normal(ks[3], (b, S, KH, D), jnp.bfloat16)
-        lens = jnp.full((b,), S, jnp.int32)
-        q = jax.random.normal(ks[2], (b, 1, H, D), jnp.bfloat16)
+    KH = H = 16
+    D, PS = 64, 128
+    cases = [("attn1k", 1024, 8, None),
+             ("attn8k", 8192, 2, None),
+             ("attn_ragged", 1024, 8,
+              [128, 256, 384, 512, 640, 768, 896, 1024])]
+    for tag, S, b, true_lens in cases:
+        try:
+            _attn_case(out, tag, S, b, true_lens, KH, H, D, PS)
+        except Exception as exc:  # noqa: BLE001 — tunnel flakes: keep
+            # the cases already measured (r5 lost attn8k+ragged to one
+            # remote-compile drop that voided the whole section)
+            print(f"[serving_bench] {tag} skipped after error: {exc!r}",
+                  flush=True)
+            out[f"{tag}_error"] = repr(exc)[:160]
+    return out
 
-        def scan_of(body, n):
-            def fn(q0):
-                def f(qq, _):
-                    return body(qq).astype(qq.dtype), None
-                return lax.scan(f, q0, None, length=n)[0]
-            return fn
 
-        def diff_time(body):
-            # 100/1600: at ~50-500 us/iter the 1500-iter delta dwarfs the
-            # tunnel's fixed-cost variance (negative estimates otherwise)
-            return diff_time_scan(lambda n: scan_of(body, n), (q,),
-                                  100, 1600, reps=3)
+def _attn_case(out, tag, S, b, true_lens, KH, H, D, PS):
+    import numpy as np
 
-        dt_k = diff_time(lambda qq: paged_attention(
-            qq, k_pool, v_pool, lens, tables, 0, pages_per_block=8,
-            interpret=False))
-        dt_x = diff_time(lambda qq: causal_attention(
-            qq, k_cat, v_cat, q_positions=(lens - 1)[:, None],
-            kv_length=lens))
-        out[f"attn{S // 1024}k_us_pallas"] = dt_k * 1e6
-        out[f"attn{S // 1024}k_us_xla"] = dt_x * 1e6
-        print(f"[serving_bench] attn{S // 1024}k pallas/xla us: "
-              f"{dt_k * 1e6:.1f}/{dt_x * 1e6:.1f}", flush=True)
+    from cloud_server_tpu.ops.attention import causal_attention
+    from cloud_server_tpu.ops.paged_attention import paged_attention
+
+    mp = S // PS
+    num_pages = b * mp
+    ks = jax.random.split(jax.random.key(1), 4)
+    k_pool = jax.random.normal(ks[0], (1, num_pages, KH, D, PS),
+                               jnp.bfloat16)
+    v_pool = jax.random.normal(ks[1], (1, num_pages, KH, D, PS),
+                               jnp.bfloat16)
+    tables = jnp.asarray(
+        np.random.RandomState(0).permutation(num_pages).reshape(b, mp),
+        jnp.int32)
+    k_cat = jax.random.normal(ks[2], (b, S, KH, D), jnp.bfloat16)
+    v_cat = jax.random.normal(ks[3], (b, S, KH, D), jnp.bfloat16)
+    lens = jnp.asarray(true_lens if true_lens is not None
+                       else [S] * b, jnp.int32)
+    q = jax.random.normal(ks[2], (b, 1, H, D), jnp.bfloat16)
+
+    # K+V bf16 bytes actually required: the kernel reads page-rounded
+    # true lengths; dense XLA streams the full padded extent
+    kern_tokens = sum(-(-int(l) // PS) * PS for l in lens)
+    kern_bytes = 2 * kern_tokens * KH * D * 2
+    xla_bytes = 2 * b * S * KH * D * 2
+
+    us_k, sp_k, rej_k = _robust_attn_us(
+        lambda qq: paged_attention(qq, k_pool, v_pool, lens, tables,
+                                   0, pages_per_block=8,
+                                   interpret=False),
+        q, kern_bytes)
+    us_x, sp_x, rej_x = _robust_attn_us(
+        lambda qq: causal_attention(qq, k_cat, v_cat,
+                                    q_positions=(lens - 1)[:, None],
+                                    kv_length=lens),
+        q, xla_bytes)
+    out[f"{tag}_us_pallas"] = us_k
+    out[f"{tag}_us_xla"] = us_x
+    out[f"{tag}_spread"] = round(max(sp_k, sp_x), 3)
+    if rej_k or rej_x:
+        out[f"{tag}_rejected_samples"] = rej_k + rej_x
+    if true_lens is not None:
+        out[f"{tag}_kernel_speedup"] = round(us_x / us_k, 3)
+    print(f"[serving_bench] {tag} pallas/xla us: {us_k:.1f}/{us_x:.1f}"
+          f" spread {max(sp_k, sp_x):.2f}"
+          f" rejected {rej_k + rej_x}", flush=True)
     return out
 
 
 def main() -> None:
+    """Headline-first protocol: the driver tail-parses the LAST complete
+    JSON line, and its time budget is finite — r4 learned this the hard
+    way (rc=124 with the only print at the very end: no parsed number
+    for the round). So the headline line is printed IMMEDIATELY after
+    train_bench, then RE-printed with richer extras after every section
+    that completes — a timeout or tunnel flake mid-section still leaves
+    a valid, maximally-enriched earlier line. The expensive trained-spec
+    section (trains two models in-bench; its r4 acceptance numbers —
+    n-gram 1.64, draft 2.63 — are kept in its docstring as provenance)
+    runs LAST and only inside the time budget."""
+    t_start = time.perf_counter()
+    base_tag, base = _baseline_tokens_per_sec()
+
     train = train_bench()
     extra = {
         "step_time_ms": round(train["step_time_ms"], 2),
         "approx_mfu": round(train["approx_mfu"], 4),
         "device": str(jax.devices()[0]),
+        "baseline_round": base_tag,
     }
-    if os.environ.get("BENCH_SKIP_LONGSEQ") != "1":
-        extra.update({k: round(v, 2) for k, v in
-                      longseq_attention_bench().items()})
-    if os.environ.get("BENCH_SKIP_SERVING") != "1":
-        extra.update({k: round(v, 1) for k, v in serving_bench().items()})
 
-    base_tag, base = _baseline_tokens_per_sec()
-    extra["baseline_round"] = base_tag
-    print(json.dumps({
-        "metric": "train_tokens_per_sec_330M_bf16",
-        "value": round(train["tokens_per_sec"], 1),
-        "unit": "tokens/s",
-        "vs_baseline": (round(train["tokens_per_sec"] / base, 4)
-                        if base > 0 else 1.0),
-        "extra": extra,
-    }))
+    def emit() -> None:
+        # ONE self-contained JSON line per call, atomically flushed
+        print(json.dumps({
+            "metric": "train_tokens_per_sec_330M_bf16",
+            "value": round(train["tokens_per_sec"], 1),
+            "unit": "tokens/s",
+            "vs_baseline": (round(train["tokens_per_sec"] / base, 4)
+                            if base > 0 else 1.0),
+            "extra": extra,
+        }), flush=True)
+
+    emit()  # the driver has a parsed headline (incl. MFU) from here on
+
+    def section(name: str, skip_env: str | None, fn, ndigits: int) -> None:
+        if skip_env and os.environ.get(skip_env) == "1":
+            return
+        try:
+            rows = fn()
+        except Exception as exc:  # noqa: BLE001 — tunnel flakes happen
+            print(f"[bench] section {name} skipped after error: {exc!r}",
+                  flush=True)
+            extra[f"{name}_error"] = repr(exc)[:200]
+        else:
+            extra.update({k: round(v, ndigits) if isinstance(v, float)
+                          else v for k, v in rows.items()})
+        emit()
+
+    section("longseq", "BENCH_SKIP_LONGSEQ", longseq_attention_bench, 2)
+    section("serving", "BENCH_SKIP_SERVING", serving_bench, 1)
+    section("longcontext_attn", "BENCH_SKIP_SERVING",
+            _longcontext_attention_bench, 2)
+
+    budget_s = float(os.environ.get("BENCH_TIME_BUDGET_S", "1500"))
+    elapsed = time.perf_counter() - t_start
+    if os.environ.get("BENCH_SKIP_SERVING") != "1" and elapsed < budget_s:
+        section("trained_spec", None, _trained_spec_bench, 1)
+    else:
+        extra["trained_spec_skipped_at_s"] = round(elapsed, 1)
+        emit()
 
 
 if __name__ == "__main__":

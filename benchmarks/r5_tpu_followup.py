@@ -1,0 +1,109 @@
+"""One TPU session for the r5 follow-up measurements:
+
+  1. churn with the new admit_decode_chunk knob (1 vs None) — the
+     TTFT-p95 claim needs an on-chip A/B at equal throughput;
+  2. the ragged + 8k attention cases that r5's first bench run lost to
+     a remote-compile flake (attn1k succeeded: 50.4/73.0 us).
+
+Prints one JSON line per result block. Run with the axon env, nothing
+else on the box.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+import bench as B
+
+
+def churn_ab():
+    import dataclasses
+
+    import numpy as np
+
+    from cloud_server_tpu.config import InferConfig, ModelConfig
+    from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+    from cloud_server_tpu.models import transformer
+
+    base = ModelConfig(
+        vocab_size=32000, embed_dim=1024, num_layers=16, num_heads=16,
+        num_kv_heads=16, head_dim=64, mlp_dim=4096, max_seq_len=1024,
+        dtype="bfloat16", param_dtype="float32", remat="none",
+        decode_attention_impl="pallas")
+    infer_cfg = InferConfig(max_decode_len=900, temperature=1.0,
+                            eos_token_id=-1, pad_token_id=0)
+    params = transformer.init_params(base, jax.random.key(0))
+
+    def scenario(admit_chunk):
+        srv = PagedInferenceServer(
+            params, base, infer_cfg, max_slots=16, max_context=1024,
+            page_size=128, prefill_chunk=256, decode_chunk=8,
+            prompt_buckets=[64, 256, 512],
+            admit_decode_chunk=admit_chunk)
+        rng = np.random.RandomState(0)
+
+        def mk(n):
+            return [int(x) for x in rng.randint(1, 30000, size=n)]
+
+        first = [srv.submit(mk(64), max_new_tokens=256) for _ in range(8)]
+        for _ in range(2):
+            srv.step()
+        t0 = time.perf_counter()
+        waves = []
+        for _ in range(3):
+            waves += [srv.submit(mk(400), max_new_tokens=128)
+                      for _ in range(4)]
+            for _ in range(6):
+                srv.step()
+        srv.run_until_idle()
+        dt = time.perf_counter() - t0
+        srv.stop()
+        return first, waves, dt
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    out = {}
+    for tag, knob in (("knob1", 1), ("knob_off", None)):
+        scenario(knob)  # warm every dispatch shape
+        first, waves, dt = scenario(knob)
+        total = sum(len(r.tokens) for r in first + waves)
+        ttfts = [r.emit_times[0] - r.submit_time
+                 for r in waves if r.emit_times]
+        out[f"churn_tok_s_{tag}"] = round(total / dt, 1)
+        out[f"churn_ttft_ms_p50_{tag}"] = round(pct(ttfts, .5) * 1e3, 1)
+        out[f"churn_ttft_ms_p95_{tag}"] = round(pct(ttfts, .95) * 1e3, 1)
+        print(json.dumps({k: v for k, v in out.items() if tag in k}),
+              flush=True)
+    return out
+
+
+def attn_cases():
+    out = {}
+    KH = H = 16
+    D, PS = 64, 128
+    for tag, S, b, lens in (
+            ("attn_ragged", 1024, 8,
+             [128, 256, 384, 512, 640, 768, 896, 1024]),
+            ("attn8k", 8192, 2, None)):
+        try:
+            B._attn_case(out, tag, S, b, lens, KH, H, D, PS)
+        except Exception as exc:  # noqa: BLE001
+            out[f"{tag}_error"] = repr(exc)[:160]
+        print(json.dumps({k: v for k, v in out.items() if tag in k}),
+              flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    results = {}
+    results.update(attn_cases())
+    results.update(churn_ab())
+    print("FINAL " + json.dumps(results), flush=True)
