@@ -4,16 +4,45 @@
 # (one relay session per python process wedges concurrent runs and is
 # pointless for CPU tests).
 #
-# Default: the FAST set (~5-6 min) — everything except the tests marked
-# slow via tests/slow_tests.txt, which still covers every parallelism
-# family (dp/fsdp/tp, sp-ring, ulysses, pp, ep, hybrid-dcn) plus the
-# engine/server/checkpoint flows.
-#   ./run_tests.sh --all   # full sweep (~30 min)
+# The suite runs as THREE sequential pytest processes. This is a
+# workaround for a PROVEN environment ceiling, not a style choice:
+# each jit compilation leaks memory mappings (LLVM JIT code pages are
+# never unmapped in-process), and once the process crosses
+# vm.max_map_count (65530 here) the next XLA CPU backend_compile
+# SEGFAULTS instead of erroring. Measured r5: /proc/<pid>/num_maps
+# grows ~linearly with tests run and the crash lands within ~400 maps
+# of the ceiling, reproduced on an UNMODIFIED r4 checkout — every
+# test file passes in isolation. Splitting keeps each process at
+# ~20-25k maps. Groups are alphabetical file ranges so ordering stays
+# stable and predictable.
+#
+# Default: the FAST set (~5-6 min/group) — everything except the tests
+# marked slow via tests/slow_tests.txt, which still covers every
+# parallelism family (dp/fsdp/tp, sp-ring, ulysses, pp, ep, hybrid-dcn)
+# plus the engine/server/checkpoint flows.
+#   ./run_tests.sh --all   # full sweep (~35 min)
 #   ./run_tests.sh <pytest args...>  # fast set with extra args
 MARK=(-m "not slow")
 if [ "$1" = "--all" ]; then
     MARK=(); shift
 fi
 if [ "$#" -eq 0 ]; then set -- -x -q; fi
-exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python -m pytest tests/ "${MARK[@]}" "$@"
+
+shopt -s nullglob  # an empty group must not reach pytest as a literal
+rc=0
+for group in 'tests/test_[a-f]*.py' 'tests/test_[g-o]*.py' \
+             'tests/test_[p-z]*.py'; do
+    files=( $group )
+    if [ "${#files[@]}" -eq 0 ]; then
+        continue
+    fi
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python -m pytest "${files[@]}" "${MARK[@]}" "$@"
+    grc=$?
+    # 5 = "no tests collected" (a group can be empty under -m filters)
+    if [ "$grc" -ne 0 ] && [ "$grc" -ne 5 ]; then
+        rc=$grc
+        break
+    fi
+done
+exit $rc
