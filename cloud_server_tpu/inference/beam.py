@@ -1,0 +1,137 @@
+"""Exact width-k beam search over the batch engine.
+
+TPU-first: all k beams of all B prompts ride ONE (B*k)-row batched KV
+cache. Each step is a single batched `decode_step` forward, a top-2k
+candidate selection, and a batched cache-row gather (the beam reorder)
+— no per-beam dispatches, static shapes throughout, the whole search
+is one `lax.scan` inside one jit per (B, P, k, max_new) signature.
+
+Selection follows the standard 2k-candidate scheme (t5x/flax lineage):
+each step takes the top 2k of cum_logprob + log p(token) over the k*V
+continuations; candidates ending in EOS retire into a per-prompt
+finished pool (score length-normalised by `length_penalty`), the best
+k non-EOS candidates continue. Live beams still running at max_new
+merge into the pool at the end, so the search always returns k ranked
+hypotheses.
+
+Reference parity note: view-sonic/Cloud-Server @ v0 is an empty tree
+(SURVEY.md); this subsystem is part of the re-scoped build inventory
+(search-based decoding for the batch API).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cloud_server_tpu.config import ModelConfig
+from cloud_server_tpu.inference import engine
+
+NEG_INF = -1e30
+
+
+def _norm_score(cum: jnp.ndarray, length, length_penalty: float):
+    """Length-normalised ranking score: cum_logprob / len**penalty.
+    penalty 0 = raw sum (longer means worse); 1 = mean logprob."""
+    return cum / jnp.maximum(length, 1).astype(jnp.float32) ** length_penalty
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "k", "max_new", "eos_token_id",
+                                    "pad_token_id", "length_penalty",
+                                    "max_len"))
+def beam_search(params, prompt: jnp.ndarray, *, cfg: ModelConfig,
+                k: int = 4, max_new: int = 16, eos_token_id: int = -1,
+                pad_token_id: int = 0, length_penalty: float = 1.0,
+                max_len: int | None = None,
+                prompt_lengths: jnp.ndarray | None = None):
+    """prompt: (B, P) int32 (right-padded; pass prompt_lengths when
+    ragged). Returns (tokens (B, k, max_new) int32 padded past EOS,
+    scores (B, k) f32), best-first per prompt."""
+    b, p = prompt.shape
+    max_len = max_len or (p + max_new)
+    if max_len < p + max_new:
+        raise ValueError(f"max_len={max_len} < prompt + max_new")
+    cache = engine.init_cache(cfg, b, max_len)
+    logits, cache = engine.prefill(params, prompt, cfg, cache,
+                                   prompt_lengths)
+
+    # tile the prompt cache k-fold: rows [i*k, (i+1)*k) are prompt i's
+    # beams (a device-side repeat — the prompt is prefilled ONCE)
+    def tile(x):
+        return None if x is None else jnp.repeat(x, k, axis=1)
+
+    cache = engine.KVCache(k=tile(cache.k), v=tile(cache.v),
+                           length=jnp.repeat(cache.length, k),
+                           k_scale=tile(cache.k_scale),
+                           v_scale=tile(cache.v_scale))
+    logits = jnp.repeat(logits, k, axis=0)  # (B*k, V)
+    v = logits.shape[-1]
+
+    # beam 0 is the only live hypothesis at t=0 (all beams are identical
+    # until the first selection — duplicates would crowd out the search)
+    cum0 = jnp.full((b, k), NEG_INF, jnp.float32).at[:, 0].set(0.0)
+    seq0 = jnp.full((b, k, max_new), pad_token_id, jnp.int32)
+    fin_t0 = jnp.full((b, k, max_new), pad_token_id, jnp.int32)
+    fin_s0 = jnp.full((b, k), NEG_INF, jnp.float32)
+    bidx = jnp.arange(b)[:, None]
+
+    def step(carry, t):
+        logits, cache, cum, seq, fin_t, fin_s = carry
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        cand = cum[:, :, None] + logp.reshape(b, k, v)  # (B, k, V)
+        sc2k, idx2k = lax.top_k(cand.reshape(b, k * v), 2 * k)
+        parent = idx2k // v          # (B, 2k)
+        tok = idx2k % v              # (B, 2k)
+        # candidate sequences: parent's history + the new token at t
+        parent_seq = seq[bidx, parent]                     # (B, 2k, M)
+        cand_seq = parent_seq.at[:, :, t].set(tok)
+        is_eos = tok == eos_token_id
+
+        # EOS candidates retire; the EOS token itself is NOT stored
+        # (matching the servers' emit rule) but its position counts
+        # toward the length normalisation (t+1)
+        fin_cand = jnp.where(is_eos,
+                             _norm_score(sc2k, t + 1, length_penalty),
+                             NEG_INF)
+        pool_s = jnp.concatenate([fin_s, fin_cand], axis=1)  # (B, 3k)
+        pool_t = jnp.concatenate([fin_t, parent_seq], axis=1)  # (B,3k,M)
+        fin_s, fin_idx = lax.top_k(pool_s, k)
+        fin_t = pool_t[bidx, fin_idx]
+
+        # live continuation: best k non-EOS candidates
+        live_sc = jnp.where(is_eos, NEG_INF, sc2k)
+        cum, live_idx = lax.top_k(live_sc, k)               # (B, k)
+        new_parent = jnp.take_along_axis(parent, live_idx, axis=1)
+        new_tok = jnp.take_along_axis(tok, live_idx, axis=1)
+        seq = jnp.take_along_axis(
+            cand_seq, live_idx[..., None], axis=1)
+
+        # reorder the cache rows under the surviving beams
+        flat_parent = (jnp.arange(b)[:, None] * k + new_parent).reshape(-1)
+        cache2 = engine.KVCache(
+            k=cache.k[:, flat_parent], v=cache.v[:, flat_parent],
+            length=cache.length[flat_parent],
+            k_scale=(None if cache.k_scale is None
+                     else cache.k_scale[:, flat_parent]),
+            v_scale=(None if cache.v_scale is None
+                     else cache.v_scale[:, flat_parent]))
+        logits, cache2 = engine.decode_step(params, new_tok.reshape(-1),
+                                            cfg, cache2)
+        return (logits, cache2, cum, seq, fin_t, fin_s), None
+
+    (logits, _, cum, seq, fin_t, fin_s), _ = lax.scan(
+        step, (logits, cache, cum0, seq0, fin_t0, fin_s0),
+        jnp.arange(max_new))
+
+    # live beams at the horizon join the pool, length-normalised at
+    # max_new
+    live_s = _norm_score(cum, max_new, length_penalty)
+    pool_s = jnp.concatenate([fin_s, live_s], axis=1)
+    pool_t = jnp.concatenate([fin_t, seq], axis=1)
+    scores, order = lax.top_k(pool_s, k)
+    tokens = pool_t[bidx, order]
+    return tokens, scores

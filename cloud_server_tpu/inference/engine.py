@@ -292,6 +292,42 @@ def verify_step(params, tokens: jnp.ndarray, cfg: ModelConfig,
 
 
 # ---------------------------------------------------------------------------
+# Embeddings
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def encode(params, tokens: jnp.ndarray, lengths: jnp.ndarray, *,
+           cfg: ModelConfig) -> jnp.ndarray:
+    """Sequence embeddings: the decoder run WITHOUT unembedding,
+    final-norm hidden states mean-pooled over each sequence's valid
+    positions, L2-normalised. tokens: (B, P) right-padded int32;
+    lengths: (B,) int32. Returns (B, embed_dim) f32, unit norm.
+
+    Right-padding is exact under causal attention (real positions never
+    attend to the trailing pads; pad positions are masked out of the
+    pool), so one batched pass serves ragged inputs."""
+    b, p = tokens.shape
+    cos, sin = rope_table(cfg, p)
+    x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
+    attn_fn = transformer._get_attention_fn(cfg)
+
+    def scan_body(x, lp):
+        q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin)
+        o = attn_fn(q, k, v)
+        x = transformer.attention_out(x, o, lp, cfg)
+        x = _mlp_apply(x, lp, cfg)
+        return x, None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    mask = jnp.arange(p)[None, :] < lengths[:, None]
+    pooled = (x.astype(jnp.float32) * mask[..., None]).sum(axis=1)
+    pooled = pooled / jnp.maximum(lengths[:, None], 1)
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-9)
+
+
+# ---------------------------------------------------------------------------
 # Generate
 # ---------------------------------------------------------------------------
 

@@ -653,3 +653,224 @@ def json_object_regex(max_depth: int = 1) -> str:
         value = f"({_JSON_SCALAR}|{obj}|{arr})"
     return (f"\\{{{ws}({_JSON_STRING}{ws}:{ws}{value}"
             f"({ws},{ws}{_JSON_STRING}{ws}:{ws}{value})*)?{ws}\\}}")
+
+
+# ---------------------------------------------------------------------------
+# JSON Schema -> regex (structured output beyond bare json_object mode)
+# ---------------------------------------------------------------------------
+
+_RE_SPECIAL = frozenset(b"\\()[]{}*+?|.^$-")
+_WS = r"[ \n\t]*"
+_JSON_INTEGER = r"-?(0|[1-9][0-9]*)"
+# one JSON-text string "character": a plain char or an escape sequence
+_JSON_CHAR = r'([^"\\\x00-\x1f]|\\["\\/bfnrt]|\\u[0-9a-fA-F]{4})'
+
+
+def _re_escape(text: str) -> str:
+    """Literal text -> this module's regex dialect (byte-wise; regex
+    metacharacters backslash-escaped, non-printable bytes as \\xNN)."""
+    out = []
+    for b in text.encode("utf-8"):
+        if b in _RE_SPECIAL:
+            out.append("\\" + chr(b))
+        elif 0x20 <= b < 0x7F:
+            out.append(chr(b))
+        else:
+            out.append(f"\\x{b:02X}")
+    return "".join(out)
+
+
+def _json_literal(value) -> str:
+    """A python value -> regex matching exactly its canonical JSON
+    spelling (ensure_ascii keeps the bytes printable)."""
+    import json as _json
+    return _re_escape(_json.dumps(value, separators=(",", ":")))
+
+
+def json_schema_regex(schema: dict, *, max_depth: int = 4,
+                      max_optional: int = 6) -> str:
+    """Compile a practical JSON-Schema subset into a regex for the
+    byte-DFA -> token-table pipeline (the same machinery json_object
+    mode uses; exact through sampling and speculation).
+
+    Supported: `type` object / array / string / integer / number /
+    boolean / null (or a list of those), `properties` + `required`,
+    `enum` / `const` (any JSON values), `anyOf` / `oneOf`, `items`,
+    `minItems` / `maxItems`, `minLength` / `maxLength`. Semantics are
+    generation-oriented (OpenAI structured-output conventions):
+
+      * objects are CLOSED (no additional properties) and their keys
+        appear in declared order; optional keys (absent from
+        `required`) may be omitted — at most `max_optional` optional
+        keys per object (the ordering regex doubles per optional key);
+      * nesting is bounded by `max_depth` (depth-k JSON is regular;
+        unbounded nesting is not);
+      * arrays without `items`, and bare {} subschemas, accept any
+        scalar;
+      * numeric ranges (`minimum` / `maximum`), string `pattern`, and
+        `additionalProperties` are rejected loudly rather than
+        silently ignored.
+
+    Raises ValueError on anything outside the subset."""
+    if not isinstance(schema, dict):
+        raise ValueError("schema must be a JSON object")
+    return _schema_re(schema, max_depth, max_optional)
+
+
+_UNSUPPORTED = ("minimum", "maximum", "exclusiveMinimum",
+                "exclusiveMaximum", "multipleOf", "pattern",
+                "additionalProperties", "patternProperties", "allOf",
+                "not", "$ref", "uniqueItems", "minProperties",
+                "maxProperties")
+
+# optional-key ordering doubles the regex per optional key and nesting
+# multiplies levels together, so a small schema can compound into a
+# multi-GB pattern. Checked at EVERY recursion return (bottom-up), so
+# an inner level trips the cap before an outer level multiplies it —
+# peak memory stays ~branching x cap, never the full product.
+MAX_SCHEMA_REGEX = 1 << 20  # 1 MB of pattern is already a huge DFA
+
+
+def _schema_re(s, depth: int, max_opt: int) -> str:
+    out = _schema_re_inner(s, depth, max_opt)
+    if len(out) > MAX_SCHEMA_REGEX:
+        raise ValueError(
+            "json_schema: schema compiles to a regex over "
+            f"{MAX_SCHEMA_REGEX >> 20} MB (optional-key combinations "
+            "double per optional key and compound across nesting); "
+            "mark more keys required or flatten the schema")
+    return out
+
+
+def _schema_re_inner(s, depth: int, max_opt: int) -> str:
+    if not isinstance(s, dict):
+        raise ValueError(f"subschema must be an object, got {type(s)}")
+    for key in _UNSUPPORTED:
+        if key in s:
+            raise ValueError(
+                f"json_schema: {key!r} is not supported (the regex/DFA "
+                "pipeline cannot express it); remove it or use a "
+                "supported equivalent")
+    if "const" in s:
+        return _json_literal(s["const"])
+    if "enum" in s:
+        if not s["enum"]:
+            raise ValueError("json_schema: empty enum matches nothing")
+        return "(" + "|".join(_json_literal(v) for v in s["enum"]) + ")"
+    for comb in ("anyOf", "oneOf"):
+        if comb in s:
+            branches = s[comb]
+            if not isinstance(branches, list) or not branches:
+                raise ValueError(f"json_schema: {comb} needs a non-empty "
+                                 "list")
+            return ("(" + "|".join(_schema_re(b, depth, max_opt)
+                                   for b in branches) + ")")
+    t = s.get("type")
+    if isinstance(t, list):
+        if not t:
+            raise ValueError("json_schema: empty type list")
+        return ("(" + "|".join(_schema_re({**s, "type": one}, depth,
+                                          max_opt)
+                               for one in t) + ")")
+    if t == "object" or (t is None and "properties" in s):
+        return _object_re(s, depth, max_opt)
+    if t == "array" or (t is None and "items" in s):
+        return _array_re(s, depth, max_opt)
+    if t == "string":
+        lo = int(s.get("minLength", 0))
+        hi = s.get("maxLength")
+        if lo == 0 and hi is None:
+            return _JSON_STRING
+        _check_bound(lo, hi, "minLength/maxLength")
+        reps = (f"{{{lo},}}" if hi is None else f"{{{lo},{int(hi)}}}")
+        return f'"{_JSON_CHAR}{reps}"'
+    if t == "integer":
+        return _JSON_INTEGER
+    if t == "number":
+        return _JSON_NUMBER
+    if t == "boolean":
+        return "(true|false)"
+    if t == "null":
+        return "null"
+    if t is None:
+        return _JSON_SCALAR  # unconstrained subschema: any scalar
+    raise ValueError(f"json_schema: unsupported type {t!r}")
+
+
+def _object_re(s, depth: int, max_opt: int) -> str:
+    if depth <= 0:
+        raise ValueError("json_schema: nesting exceeds max_depth")
+    props = s.get("properties", {})
+    if not isinstance(props, dict):
+        raise ValueError('json_schema: "properties" must be an object')
+    required = s.get("required", [])
+    unknown = set(required) - set(props)
+    if unknown:
+        raise ValueError(f"json_schema: required keys {sorted(unknown)} "
+                         "missing from properties")
+    req = set(required)
+    n_opt = sum(1 for k in props if k not in req)
+    if n_opt > max_opt:
+        raise ValueError(
+            f"json_schema: {n_opt} optional properties > max_optional="
+            f"{max_opt} (the ordering regex doubles per optional key); "
+            "mark more keys required or raise max_optional")
+    keys = list(props)
+    items = [f"{_json_literal(k)}{_WS}:{_WS}"
+             f"{_schema_re(props[k], depth - 1, max_opt)}"
+             for k in keys]
+
+    # properties in declared order, commas between those present;
+    # memoized over (index, anything-emitted-yet) so the string grows
+    # ~2x per OPTIONAL key only
+    memo: dict[tuple[int, bool], str] = {}
+
+    def tail(i: int, seen: bool) -> str:
+        if i == len(keys):
+            return ""
+        got = memo.get((i, seen))
+        if got is not None:
+            return got
+        sep = f"{_WS},{_WS}" if seen else ""
+        with_it = f"{sep}{items[i]}{tail(i + 1, True)}"
+        if keys[i] in req:
+            out = with_it
+        else:
+            out = f"({with_it}|{tail(i + 1, seen)})"
+        memo[(i, seen)] = out
+        return out
+
+    return f"\\{{{_WS}{tail(0, False)}{_WS}\\}}"
+
+
+def _check_bound(lo: int, hi, what: str) -> None:
+    """Schema-level bound validation: the regex engine caps {m,n}
+    repeats at 256, so an oversize bound must fail HERE with the
+    keyword named — not later as an opaque regex-internal error."""
+    if lo < 0 or (hi is not None and int(hi) < lo):
+        raise ValueError(f"json_schema: bad {what}")
+    if lo > 256 or (hi is not None and int(hi) > 256):
+        raise ValueError(
+            f"json_schema: {what} above 256 is not supported (the "
+            "DFA pipeline caps bounded repeats at 256); drop the bound "
+            "or lower it")
+
+
+def _array_re(s, depth: int, max_opt: int) -> str:
+    if depth <= 0:
+        raise ValueError("json_schema: nesting exceeds max_depth")
+    item = _schema_re(s.get("items", {}), depth - 1, max_opt)
+    lo = int(s.get("minItems", 0))
+    hi = s.get("maxItems")
+    _check_bound(lo, hi, "minItems/maxItems")
+    more = f"{_WS},{_WS}{item}"
+    if hi is None:
+        body = (f"({item}({more})*)?" if lo == 0
+                else f"{item}({more}){{{lo - 1},}}")
+    elif int(hi) == 0:
+        body = ""
+    elif lo == 0:
+        body = f"({item}({more}){{0,{int(hi) - 1}}})?"
+    else:
+        body = f"{item}({more}){{{lo - 1},{int(hi) - 1}}}"
+    return f"\\[{_WS}{body}{_WS}\\]"

@@ -25,13 +25,21 @@ Endpoints:
   POST /v1/completions        OpenAI-compatible text completion:
                     prompt (string, token list, or list of either),
                     max_tokens, temperature, top_p, stop, seed, n,
-                    presence_penalty, frequency_penalty, logprobs,
-                    stream (SSE chunks, final `data: [DONE]`).
+                    best_of (candidates ranked by mean token logprob,
+                    best n returned), presence_penalty,
+                    frequency_penalty, logprobs, response_format
+                    ({"type": "json_object"} or {"type": "json_schema",
+                    "json_schema": {"schema": ...}} — compiled to a
+                    device-side token DFA), stream (SSE chunks, final
+                    `data: [DONE]`).
   POST /v1/chat/completions   OpenAI-compatible chat: messages are
                     rendered through the chat template (the attached
                     tokenizer's own, when it has one, else a minimal
                     role-tagged format); same sampling fields; stream
                     sends `chat.completion.chunk` deltas.
+  POST /v1/embeddings         mean-pooled, L2-normalised final hidden
+                    states for input (string / token list / list of
+                    either), OpenAI response shape.
   GET  /v1/models   {"object": "list", "data": [{"id": ...}]}
   GET  /healthz     {"ok": true, "active": N, "pending": N}
   GET  /metrics     Prometheus text exposition (occupancy, lifetime
@@ -47,6 +55,12 @@ String `stop` entries are tokenized and enforced at token level
 (server-side emit rule); with BPE tokenizers a stop string that merges
 across a token boundary in the generation may not match — token-id
 stops are exact.
+
+Lifecycle: a streaming client that disconnects mid-generation aborts
+its request (BrokenPipe -> Request.cancel(); the scheduler frees the
+slot and pages within one step). When the backend is constructed with
+`max_pending`, submissions past the bound return HTTP 429 — clients
+retry instead of growing host memory.
 
 Demo (server side: `python -m cloud_server_tpu.generate --serve-http
 8000 ...` or `HttpFrontend(srv, tok).start()`):
@@ -69,6 +83,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from cloud_server_tpu.inference.sampling import SamplingParams
+from cloud_server_tpu.inference.server import QueueFullError
 
 _STREAM_END = object()
 
@@ -258,7 +273,8 @@ class HttpFrontend:
             def do_POST(self):
                 routes = {"/generate": front._handle_generate,
                           "/v1/completions": front._handle_completions,
-                          "/v1/chat/completions": front._handle_chat}
+                          "/v1/chat/completions": front._handle_chat,
+                          "/v1/embeddings": front._handle_embeddings}
                 handler = routes.get(self.path)
                 if handler is None:
                     self._json(404, {"error": "unknown path"})
@@ -276,6 +292,8 @@ class HttpFrontend:
                     # non-object messages) surface wherever they break —
                     # all are client errors, never handler-thread crashes
                     self._json(400, {"error": str(exc)})
+                except QueueFullError as exc:  # backpressure, retryable
+                    self._json(429, {"error": str(exc)})
                 except RuntimeError as exc:  # scheduler stopped/crashed
                     self._json(503, {"error": str(exc)})
 
@@ -393,28 +411,36 @@ class HttpFrontend:
         handler.send_header("Connection", "close")
         handler.end_headers()
         emitted = 0
-        for tok in self._drain(q):
-            line = {"token": tok}
-            # _emit appends the logprob before invoking the stream
-            # callback, so it is present by the time we get here
-            if emitted < len(request.logprobs):
-                line["logprob"] = request.logprobs[emitted]
-            emitted += 1
-            if self.tokenizer is not None:
-                line["text"] = self.tokenizer.decode([tok])
-            handler.wfile.write((json.dumps(line) + "\n").encode())
-            handler.wfile.flush()
-        handler.wfile.write((json.dumps(
-            {"done": True, "finish_reason": request.finish_reason,
-             "tokens": request.tokens,
-             "logprobs": request.logprobs}) + "\n").encode())
+        try:
+            for tok in self._drain(q):
+                line = {"token": tok}
+                # _emit appends the logprob before invoking the stream
+                # callback, so it is present by the time we get here
+                if emitted < len(request.logprobs):
+                    line["logprob"] = request.logprobs[emitted]
+                emitted += 1
+                if self.tokenizer is not None:
+                    line["text"] = self.tokenizer.decode([tok])
+                handler.wfile.write((json.dumps(line) + "\n").encode())
+                handler.wfile.flush()
+            handler.wfile.write((json.dumps(
+                {"done": True, "finish_reason": request.finish_reason,
+                 "tokens": request.tokens,
+                 "logprobs": request.logprobs}) + "\n").encode())
+        except (BrokenPipeError, ConnectionResetError):
+            # the client went away: stop generating on its behalf — the
+            # scheduler frees the slot and pages within one step
+            request.cancel()
 
     # -- OpenAI-compatible endpoints ----------------------------------------
 
     def _openai_sampling(self, body: dict):
         """(max_tokens, SamplingParams) with OpenAI aliases folded in:
-        max_tokens, and response_format {"type": "json_object"} ->
-        the canned bounded-depth JSON grammar."""
+        max_tokens; response_format {"type": "json_object"} -> the
+        canned bounded-depth JSON grammar; response_format
+        {"type": "json_schema", "json_schema": {"schema": {...}}} ->
+        the schema compiled through json_schema_regex (closed objects,
+        declared key order — OpenAI structured-output semantics)."""
         max_new = body.get("max_tokens", body.get("max_new_tokens"))
         if max_new is not None and not isinstance(max_new, int):
             raise ValueError('"max_tokens" must be an int')
@@ -424,6 +450,24 @@ class HttpFrontend:
                 json_object_regex
             body = dict(body)
             body.setdefault("regex", json_object_regex())
+        elif isinstance(rf, dict) and rf.get("type") == "json_schema":
+            from cloud_server_tpu.inference.grammar import \
+                json_schema_regex
+            wrapper = rf.get("json_schema")
+            if not isinstance(wrapper, dict):
+                raise ValueError('response_format json_schema needs a '
+                                 '"json_schema" object')
+            schema = wrapper.get("schema")
+            if schema is None:  # accept a bare schema in place of the
+                # OpenAI {"name", "schema"} wrapper, but not junk
+                looks = ("type", "properties", "enum", "const", "anyOf",
+                         "oneOf")
+                if not any(k in wrapper for k in looks):
+                    raise ValueError(
+                        'response_format json_schema needs a "schema"')
+                schema = wrapper
+            body = dict(body)
+            body.setdefault("regex", json_schema_regex(schema))
         return max_new, _parse_sampling(body, self.tokenizer)
 
     def _prompt_variants(self, body: dict) -> list[list[int]]:
@@ -474,6 +518,13 @@ class HttpFrontend:
         n = body.get("n", 1)
         if not isinstance(n, int) or n < 1:
             raise ValueError('"n" must be a positive int')
+        best_of = body.get("best_of", n)
+        if not isinstance(best_of, int) or best_of < n:
+            raise ValueError('"best_of" must be an int >= n')
+        if best_of > 20:  # OpenAI's own cap; bounds the fan-out
+            raise ValueError('"best_of" must be <= 20')
+        if best_of > n and body.get("stream"):
+            raise ValueError('"best_of" cannot be used with streaming')
         want_logprobs = body.get("logprobs") is not None
         rid = f"cmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
@@ -488,41 +539,78 @@ class HttpFrontend:
                 prompts[0], max_new, sampling, **self._adapter_kw(body))
             self._sse_head(handler)
             stream = _TextStream(self.tokenizer)
-            for tok in self._drain(q):
-                delta = stream.feed([tok])
-                if delta:
-                    self._sse(handler, {
-                        **base,
-                        "choices": [{"text": delta, "index": 0,
-                                     "logprobs": None,
-                                     "finish_reason": None}]})
-            tail = stream.flush()
-            choice = {"text": tail, "index": 0, "logprobs": None,
-                      "finish_reason": _finish(request.finish_reason)}
-            self._sse(handler, {**base, "choices": [choice]})
-            handler.wfile.write(b"data: [DONE]\n\n")
-            handler.wfile.flush()
+            try:
+                for tok in self._drain(q):
+                    delta = stream.feed([tok])
+                    if delta:
+                        self._sse(handler, {
+                            **base,
+                            "choices": [{"text": delta, "index": 0,
+                                         "logprobs": None,
+                                         "finish_reason": None}]})
+                tail = stream.flush()
+                choice = {"text": tail, "index": 0, "logprobs": None,
+                          "finish_reason": _finish(request.finish_reason)}
+                self._sse(handler, {**base, "choices": [choice]})
+                handler.wfile.write(b"data: [DONE]\n\n")
+                handler.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                request.cancel()  # client disconnected mid-stream
             return
 
         def choice_sampling(k: int):
-            # n > 1 with an explicit seed must still give n DISTINCT
-            # samples: derive per-choice seeds deterministically
-            if n > 1 and sampling is not None and sampling.seed is not None:
+            # multiple candidates with an explicit seed must still be
+            # DISTINCT samples: derive per-candidate seeds
+            if (best_of > 1 and sampling is not None
+                    and sampling.seed is not None):
                 import dataclasses as _dc
                 return _dc.replace(
                     sampling, seed=(sampling.seed + k) % (2 ** 32))
             return sampling
 
         akw = self._adapter_kw(body)
-        reqs = [self.srv.submit(p, max_new_tokens=max_new,
-                                sampling=choice_sampling(k), **akw)
-                for p in prompts for k in range(n)]
+        cands, submitted = [], []
+        try:
+            for p in prompts:
+                cands.append([])
+                for k in range(best_of):
+                    r = self.srv.submit(p, max_new_tokens=max_new,
+                                        sampling=choice_sampling(k),
+                                        **akw)
+                    cands[-1].append(r)
+                    submitted.append(r)
+        except Exception:
+            # a mid-fan-out failure (e.g. QueueFullError) must not
+            # leave the earlier candidates decoding for no one
+            for r in submitted:
+                r.cancel()
+            raise
+        try:
+            for group in cands:
+                for r in group:
+                    r.result()
+        except Exception:
+            for r in submitted:  # same rule for mid-GENERATION failure
+                r.cancel()
+            raise
+        if best_of > n:
+            # OpenAI best_of: rank the candidates by mean token logprob
+            # (the model's own raw distribution) and return the best n
+            def mean_lp(r):
+                return (sum(r.logprobs) / len(r.logprobs)
+                        if r.logprobs else float("-inf"))
+
+            cands = [sorted(group, key=mean_lp, reverse=True)[:n]
+                     for group in cands]
+        reqs = [r for group in cands for r in group]
         choices = []
-        usage_p = usage_c = 0
+        # OpenAI usage semantics: EVERY best_of candidate's completion
+        # tokens count (they were all generated); the prompt counts
+        # ONCE per prompt, not per candidate
+        usage_p = sum(len(p) for p in prompts)
+        usage_c = sum(len(r.tokens) for r in submitted)
         for i, r in enumerate(reqs):
             toks = r.result()
-            usage_p += len(r.prompt)
-            usage_c += len(toks)
             choice = {
                 "text": (self.tokenizer.decode(toks)
                          if self.tokenizer is not None else ""),
@@ -544,6 +632,47 @@ class HttpFrontend:
                       "completion_tokens": usage_c,
                       "total_tokens": usage_p + usage_c}})
 
+    def _handle_embeddings(self, handler, body: dict) -> None:
+        """OpenAI /v1/embeddings: input is a string, a token list, or a
+        list of either; vectors are the backend's mean-pooled
+        L2-normalised final hidden states."""
+        embed_fn = getattr(self.srv, "embed", None)
+        if embed_fn is None:
+            raise ValueError(
+                "this serving backend does not support embeddings")
+        raw = body.get("input")
+        if raw is None:
+            raise ValueError('body needs "input"')
+        if isinstance(raw, str) or (
+                isinstance(raw, list) and raw
+                and all(isinstance(t, int) for t in raw)):
+            raw = [raw]
+        if not isinstance(raw, list) or not raw:
+            raise ValueError('"input" must be a string, a token list, '
+                             "or a non-empty list of those")
+        token_lists = []
+        for item in raw:
+            if isinstance(item, str):
+                if self.tokenizer is None:
+                    raise ValueError("no tokenizer attached; send token "
+                                     "lists instead")
+                token_lists.append(self.tokenizer.encode(item) or [0])
+            elif (isinstance(item, list) and item
+                  and all(isinstance(t, int) for t in item)):
+                token_lists.append(item)
+            else:
+                raise ValueError('"input" entries must be non-empty '
+                                 "strings or token-id lists")
+        vecs = embed_fn(token_lists)
+        handler._json(200, {
+            "object": "list",
+            "model": body.get("model", self.model_id),
+            "data": [{"object": "embedding", "index": i,
+                      "embedding": [float(x) for x in v]}
+                     for i, v in enumerate(vecs)],
+            "usage": {"prompt_tokens": sum(map(len, token_lists)),
+                      "total_tokens": sum(map(len, token_lists))}})
+
     def _handle_chat(self, handler, body: dict) -> None:
         messages = body.get("messages")
         if not isinstance(messages, list) or not messages:
@@ -562,29 +691,32 @@ class HttpFrontend:
             request, q = self._submit_streaming(
                 prompt, max_new, sampling, **self._adapter_kw(body))
             self._sse_head(handler)
-            self._sse(handler, {
-                **base, "object": "chat.completion.chunk",
-                "choices": [{"index": 0,
-                             "delta": {"role": "assistant"},
-                             "finish_reason": None}]})
             stream = _TextStream(self.tokenizer)
-            for tok in self._drain(q):
-                delta = stream.feed([tok])
-                if delta:
-                    self._sse(handler, {
-                        **base, "object": "chat.completion.chunk",
-                        "choices": [{"index": 0,
-                                     "delta": {"content": delta},
-                                     "finish_reason": None}]})
-            tail = stream.flush()
-            delta = {"content": tail} if tail else {}
-            self._sse(handler, {
-                **base, "object": "chat.completion.chunk",
-                "choices": [{"index": 0, "delta": delta,
-                             "finish_reason":
-                                 _finish(request.finish_reason)}]})
-            handler.wfile.write(b"data: [DONE]\n\n")
-            handler.wfile.flush()
+            try:
+                self._sse(handler, {
+                    **base, "object": "chat.completion.chunk",
+                    "choices": [{"index": 0,
+                                 "delta": {"role": "assistant"},
+                                 "finish_reason": None}]})
+                for tok in self._drain(q):
+                    delta = stream.feed([tok])
+                    if delta:
+                        self._sse(handler, {
+                            **base, "object": "chat.completion.chunk",
+                            "choices": [{"index": 0,
+                                         "delta": {"content": delta},
+                                         "finish_reason": None}]})
+                tail = stream.flush()
+                delta = {"content": tail} if tail else {}
+                self._sse(handler, {
+                    **base, "object": "chat.completion.chunk",
+                    "choices": [{"index": 0, "delta": delta,
+                                 "finish_reason":
+                                     _finish(request.finish_reason)}]})
+                handler.wfile.write(b"data: [DONE]\n\n")
+                handler.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                request.cancel()  # client disconnected mid-stream
             return
 
         req = self.srv.submit(prompt, max_new_tokens=max_new,

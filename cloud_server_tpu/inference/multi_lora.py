@@ -46,7 +46,14 @@ class AdapterSet:
         self._ids: dict[str, int] = {}
         self._raw: list[tuple[dict, LoRAConfig]] = []
         self.stacks: dict | None = None  # {target: {"a","b"}} device
-        self.scales: jnp.ndarray | None = None  # (N+1,) f32
+        self.scales: jnp.ndarray | None = None  # (cap,) f32
+        # admission-cost amortization: stacks carry CAPACITY rows
+        # (geometric growth) and a rank headroom, so a typical add is
+        # one device row-scatter of the new adapter — not an O(total
+        # adapter bytes) host restack + re-upload per registration
+        self._cap = 0     # allocated adapter rows incl. the null row
+        self._r_cap = 0   # allocated rank (stacks' r dimension)
+        self.rebuilds = 0  # full restacks performed (observability)
 
     def __len__(self) -> int:
         return len(self._names)
@@ -90,28 +97,86 @@ class AdapterSet:
                     f"adapter {name!r} target {t!r}: a{a.shape}/"
                     f"b{b.shape} do not match the base model's "
                     f"{want_a}/{want_b}")
-        # TRANSACTIONAL: build the new stacks from a candidate list
-        # first — a shape mismatch raises here, leaving the registry
-        # untouched (a half-registered name would pass submit()'s
-        # validation and clamp-gather some other adapter's weights)
+        # TRANSACTIONAL: validation above is complete, so the fast path
+        # can mutate safely; the rebuild path builds from a candidate
+        # list first — a failure leaves the registry untouched (a
+        # half-registered name would pass submit()'s validation and
+        # clamp-gather some other adapter's weights)
+        new_id = len(self._raw) + 1
         raw2 = self._raw + [(layers, lora_cfg)]
-        try:
-            stacks, scales = self._build(raw2)
-        except (ValueError, TypeError) as exc:
-            raise ValueError(
-                f"adapter {name!r} has inconsistent shapes: {exc}"
-            ) from exc
+        fits = (self.stacks is not None
+                and new_id + 1 <= self._cap
+                and lora_cfg.rank <= self._r_cap)
+        if fits:
+            self._write_row(new_id, layers, lora_cfg)
+        else:
+            try:
+                self._rebuild(raw2)  # with geometric headroom
+            except (ValueError, TypeError) as exc:
+                raise ValueError(
+                    f"adapter {name!r} has inconsistent shapes: {exc}"
+                ) from exc
         self._names.append(name)
-        self._ids[name] = len(self._names)  # id 0 = null adapter
+        self._ids[name] = new_id  # id 0 = null adapter
         self._raw = raw2
+        return new_id
+
+    def _put(self, x):
+        if self.mesh is None:
+            return jnp.asarray(x)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(jnp.asarray(x),
+                              NamedSharding(self.mesh, P()))
+
+    def _zero_stack(self, t: str) -> dict[str, jnp.ndarray]:
+        """Capacity-sized all-zero (= null-adapter) stacks for one
+        target, shaped from the base model."""
+        from cloud_server_tpu.models.lora import _split_dims
+        from cloud_server_tpu.models.transformer import param_shapes
+        shape = param_shapes(self.model_cfg)["layers"][t]
+        L = shape[0]
+        _, fan_in, fan_out = _split_dims(t, shape)
+        return {"a": self._put(jnp.zeros((self._cap, L, fan_in,
+                                          self._r_cap), jnp.float32)),
+                "b": self._put(jnp.zeros((self._cap, L, self._r_cap,
+                                          fan_out), jnp.float32))}
+
+    def _write_row(self, i: int, layers: dict, cfg: LoRAConfig) -> None:
+        """O(one adapter) admission: scatter the new adapter's rows into
+        the device stacks (a target nobody used yet gets a fresh zero
+        stack first — earlier adapters' rows in it are correctly the
+        null adapter). The H2D traffic is the new adapter's bytes; the
+        on-device buffer copy rides HBM bandwidth.
+
+        Built on COPIES and swapped in at the end: the scheduler thread
+        may be flattening device_args()' current dict for a dispatch
+        right now (it holds _step_lock, not the registry lock), so the
+        live containers must never mutate under a reader."""
+        stacks = {t: dict(ab) for t, ab in self.stacks.items()}
+        for t in cfg.targets:
+            ab = stacks.get(t) or self._zero_stack(t)
+            a = jnp.asarray(np.asarray(layers[t]["a"], np.float32))
+            b = jnp.asarray(np.asarray(layers[t]["b"], np.float32))
+            stacks[t] = {
+                "a": ab["a"].at[i, :, :, :cfg.rank].set(a),
+                "b": ab["b"].at[i, :, :cfg.rank, :].set(b)}
+        scales = self.scales.at[i].set(cfg.scale)
         self.stacks = stacks
         self.scales = scales
-        return self._ids[name]
 
-    def _build(self, raw):
+    def _rebuild(self, raw) -> None:
+        """Full restack (first add, capacity exhausted, or a rank above
+        the allocated headroom): capacity doubles so rebuilds amortize
+        to O(1) restacked rows per add."""
+        self.rebuilds += 1
         r_max = max(cfg.rank for _, cfg in raw)
         targets = sorted({t for _, cfg in raw for t in cfg.targets})
         n = len(raw) + 1
+        cap = r_cap = 1
+        while cap < max(n, 4):
+            cap *= 2
+        while r_cap < r_max:
+            r_cap *= 2
         stacks: dict[str, dict[str, np.ndarray]] = {}
         for t in targets:
             # shapes from the first adapter carrying the target
@@ -119,24 +184,22 @@ class AdapterSet:
                        if t in cfg.targets)
             L, fan_in, _ = np.asarray(ref["a"]).shape
             fan_out = np.asarray(ref["b"]).shape[-1]
-            a = np.zeros((n, L, fan_in, r_max), np.float32)
-            b = np.zeros((n, L, r_max, fan_out), np.float32)
+            a = np.zeros((cap, L, fan_in, r_cap), np.float32)
+            b = np.zeros((cap, L, r_cap, fan_out), np.float32)
             for i, (layers, cfg) in enumerate(raw, start=1):
                 if t in cfg.targets:
                     a[i, :, :, :cfg.rank] = np.asarray(layers[t]["a"],
                                                        np.float32)
                     b[i, :, :cfg.rank, :] = np.asarray(layers[t]["b"],
                                                        np.float32)
-            stacks[t] = {"a": jnp.asarray(a), "b": jnp.asarray(b)}
-        scales = jnp.asarray([1.0] + [cfg.scale for _, cfg in raw],
-                             jnp.float32)
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            put = lambda x: jax.device_put(  # noqa: E731
-                x, NamedSharding(self.mesh, P()))
-            stacks = jax.tree.map(put, stacks)
-            scales = put(scales)
-        return stacks, scales
+            stacks[t] = {"a": a, "b": b}
+        scales = np.zeros((cap,), np.float32)
+        scales[0] = 1.0
+        scales[1:n] = [cfg.scale for _, cfg in raw]
+        self.stacks = jax.tree.map(self._put, stacks)
+        self.scales = self._put(scales)
+        self._cap = cap
+        self._r_cap = r_cap
 
     def device_args(self):
         """(stacks, scales) to pass into a dispatch (None when empty)."""

@@ -75,7 +75,8 @@ from cloud_server_tpu.inference.sampling import (
     sample_logits, sample_logits_rows, sampling_probs,
     sampling_probs_rows)
 from cloud_server_tpu.inference.server import (
-    Request, _bucket, _token_logprobs, emit_token, resolve_seed)
+    QueueFullError, Request, _bucket, _token_logprobs, emit_token,
+    resolve_seed)
 from cloud_server_tpu.inference.speculative import (
     _accept_drafts, _accept_point_mass, _ngram_drafts)
 
@@ -596,7 +597,8 @@ class PagedInferenceServer:
                  mesh=None, tp_axis: str = "tp",
                  allocation: str = "ondemand",
                  draft_params=None, draft_cfg: ModelConfig | None = None,
-                 tokenizer=None):
+                 tokenizer=None, max_pending: int | None = None,
+                 admit_decode_chunk: int | None = 1):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -784,6 +786,21 @@ class PagedInferenceServer:
         self._slots: list[_Slot | None] = [None] * max_slots
         self._jobs: list[_AdmitJob] = []
         self._pending: collections.deque[Request] = collections.deque()
+        # backpressure: submit() past this bound raises QueueFullError
+        # (HTTP 429) instead of growing host memory without limit;
+        # None = unbounded (library use, trusted callers)
+        self.max_pending = max_pending
+        self._draining = False
+        # admission-latency bound: while prefill jobs are in flight,
+        # decode dispatches shrink to this many rounds (default 1) so a
+        # prompt landing mid-decode waits ~one round — not a full
+        # decode_chunk burst — between each of its prefill chunks.
+        # TTFT p95 is set by this knob; steady-state throughput is not
+        # (decode_chunk applies whenever no admission is running).
+        # None disables the shrink (r4 behavior).
+        if admit_decode_chunk is not None and admit_decode_chunk < 1:
+            raise ValueError("admit_decode_chunk must be >= 1 or None")
+        self.admit_decode_chunk = admit_decode_chunk
         self._lock = threading.Lock()
         self._step_lock = threading.Lock()
         self._rng = jax.random.key(seed)
@@ -798,6 +815,8 @@ class PagedInferenceServer:
                adapter: str | None = None) -> Request:
         if self._stop.is_set():
             raise RuntimeError("server is stopped; not accepting requests")
+        if self._draining:
+            raise RuntimeError("server is draining; not accepting requests")
         if (adapter is not None
                 and self.adapters.adapter_id(adapter) is None):
             raise ValueError(
@@ -826,15 +845,63 @@ class PagedInferenceServer:
                       seed_used=resolve_seed(sampling, self._host_rng,
                                              self._lock),
                       submit_time=time.perf_counter())
+        req._on_cancel = self._handle_cancel  # before it can be seen
         with self._lock:
+            if (self.max_pending is not None
+                    and len(self._pending) >= self.max_pending):
+                raise QueueFullError(
+                    f"pending queue is full ({self.max_pending} requests);"
+                    " retry later")
             self._pending.append(req)
         return req
+
+    def _handle_cancel(self, req: Request) -> None:
+        """Client-thread half of Request.cancel(): a request still in
+        the pending queue finishes here, immediately. One that is
+        already admitted (slot or admission job) is reaped by the
+        scheduler's sweep at the start of the next step()."""
+        with self._lock:
+            try:
+                self._pending.remove(req)
+            except ValueError:
+                return  # admitted: the step sweep owns the teardown
+        req.finish_reason = "cancelled"
+        req._done.set()
 
     def generate(self, prompts, *, max_new_tokens=None):
         reqs = [self.submit(p, max_new_tokens=max_new_tokens)
                 for p in prompts]
         self.run_until_idle()
         return [r.tokens for r in reqs]
+
+    def embed(self, prompts: Sequence[Sequence[int]]) -> "np.ndarray":
+        """Mean-pooled, L2-normalised sequence embeddings for the base
+        model (engine.encode), padded per prompt bucket so repeat calls
+        hit the jit cache. Runs under the scheduler lock — it shares
+        the device with decode dispatches. Returns (N, embed_dim) f32."""
+        from cloud_server_tpu.inference import engine as _engine
+        if not prompts:
+            return np.zeros((0, self.cfg.embed_dim), np.float32)
+        out = np.zeros((len(prompts), self.cfg.embed_dim), np.float32)
+        by_bucket: dict[int, list[int]] = {}
+        for i, p in enumerate(prompts):
+            if len(p) == 0:
+                raise ValueError("empty prompt")
+            by_bucket.setdefault(_bucket(len(p), self.prompt_buckets),
+                                 []).append(i)
+        with self._step_lock:
+            for pb, idxs in by_bucket.items():
+                g = _pad_pow2(len(idxs))  # bound compile cache by shape
+                rows = np.full((g, pb), self.infer_cfg.pad_token_id,
+                               np.int32)
+                lens = np.ones((g,), np.int32)  # padding rows: 1 token
+                for r, i in enumerate(idxs):
+                    rows[r, :len(prompts[i])] = prompts[i]
+                    lens[r] = len(prompts[i])
+                vecs = _engine.encode(self.params, jnp.asarray(rows),
+                                      jnp.asarray(lens), cfg=self.cfg)
+                out[idxs] = np.asarray(jax.device_get(vecs))[:len(idxs)]
+        return out
 
     @property
     def num_active(self) -> int:
@@ -1172,6 +1239,14 @@ class PagedInferenceServer:
                 assert bool(job.got[i]), "first-token sample never captured"
                 self.lengths[sid] = len(slot.prompt)
                 self.last_token[sid] = int(job.toks[i])
+                if slot.req._cancel.is_set():
+                    # cancelled mid-admission: release without ever
+                    # activating (the prefilled KV keys into the radix
+                    # cache — a resubmit would reuse it)
+                    slot = self._release_slot(sid, self._committed(sid))
+                    slot.req.finish_reason = "cancelled"
+                    slot.req._done.set()
+                    continue
                 self.active[sid] = True
                 if self._emit(slot.req, int(job.toks[i]),
                               float(job.lps[i])):
@@ -1255,14 +1330,21 @@ class PagedInferenceServer:
         return n_eff
 
     def _chunk_rounds(self) -> int:
-        """Rounds this dispatch: bounded by decode_chunk and the tightest
-        remaining budget (in rounds), rounded down to a power of two."""
+        """Rounds this dispatch: bounded by decode_chunk — SHRUNK to
+        admit_decode_chunk while admission jobs are in flight, so a
+        landing prompt is not stuck behind full decode bursts between
+        its prefill chunks (this is the TTFT-vs-throughput knob; see
+        __init__) — and by the tightest remaining budget (in rounds),
+        rounded down to a power of two."""
         rem = [s.req.max_new_tokens - len(s.req.tokens)
                for i, s in enumerate(self._slots)
                if s is not None and self.active[i]]
         if not rem:
             return 1
-        n = max(1, min(self.decode_chunk, -(-min(rem) // self.window)))
+        chunk = self.decode_chunk
+        if self._jobs and self.admit_decode_chunk is not None:
+            chunk = self.admit_decode_chunk
+        n = max(1, min(chunk, -(-min(rem) // self.window)))
         p = 1
         while p * 2 <= n:
             p *= 2
@@ -1336,11 +1418,26 @@ class PagedInferenceServer:
 
     # -- scheduler ----------------------------------------------------------
 
+    def _sweep_cancelled(self) -> None:
+        """Reap cancelled requests that already hold a slot. Slots still
+        inside an admission job are left to finish their (bounded,
+        already-batched) chunks — _run_one_chunk checks the flag at
+        activation so they release without ever decoding."""
+        job_slots = {s for job in self._jobs for s in job.slots}
+        for sid, slot in enumerate(self._slots):
+            if (slot is not None and slot.req._cancel.is_set()
+                    and sid not in job_slots):
+                slot = self._release_slot(sid, self._committed(sid))
+                slot.req.finish_reason = "cancelled"
+                slot.req._done.set()
+
     def step(self) -> int:
-        """One scheduler iteration: start admissions, run ONE prefill
-        chunk per in-flight admission job (chunked prefill interleaving),
-        then one decode dispatch. Thread-safe."""
+        """One scheduler iteration: reap cancellations, start
+        admissions, run ONE prefill chunk per in-flight admission job
+        (chunked prefill interleaving), then one decode dispatch.
+        Thread-safe."""
         with self._step_lock:
+            self._sweep_cancelled()
             self._start_admissions()
             for job in list(self._jobs):
                 self._run_one_chunk(job)
@@ -1391,7 +1488,32 @@ class PagedInferenceServer:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful drain: refuse new submissions, let everything
+        already accepted run to completion. Returns True once idle,
+        False if `timeout` seconds pass first (requests keep running —
+        the caller decides whether to stop() anyway). Safe with or
+        without the background scheduler thread."""
+        self._draining = True
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+
+        def busy() -> bool:
+            return bool(self.num_pending or self.num_active or self._jobs)
+
+        while busy():
+            if deadline is not None and time.perf_counter() > deadline:
+                return False
+            if self._thread is None:
+                self.step()
+            else:
+                time.sleep(0.002)
+        return True
+
+    def stop(self, drain: bool = False,
+             timeout: float | None = None) -> None:
+        if drain and not self._stop.is_set():
+            self.drain(timeout)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)

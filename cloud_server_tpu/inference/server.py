@@ -361,6 +361,12 @@ def _deactivate(state: SlotState, slot: jnp.ndarray) -> SlotState:
                      out_counts=state.out_counts)
 
 
+class QueueFullError(RuntimeError):
+    """submit() refused: the pending queue is at its configured bound.
+    Backpressure, not failure — the HTTP front-end maps this to 429 so
+    clients retry instead of piling unbounded host memory."""
+
+
 @dataclasses.dataclass
 class Request:
     """A generation request; thread-safe completion via `result()`."""
@@ -394,6 +400,29 @@ class Request:
     # percentiles are where scheduling stalls show.
     submit_time: float | None = None
     emit_times: list[float] = dataclasses.field(default_factory=list)
+    # client-side cancellation: the flag is checked by the scheduler;
+    # `_on_cancel` is installed by the owning server at submit so a
+    # still-PENDING request can be finished without waiting for a step
+    _cancel: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    _on_cancel: Callable[["Request"], None] | None = None
+
+    def cancel(self) -> None:
+        """Abort this request. Pending requests finish immediately with
+        finish_reason "cancelled"; a request mid-admission or decoding
+        is torn down by its server's scheduler within one step (its
+        slot and pages go back through the normal release path, so the
+        KV it wrote stays reusable in the prefix cache). Idempotent;
+        a no-op once the request has finished."""
+        if self._done.is_set() or self._cancel.is_set():
+            return
+        self._cancel.set()
+        if self._on_cancel is not None:
+            self._on_cancel(self)
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
 
     def latency_stats(self) -> dict | None:
         """TTFT and inter-token-latency summary (seconds); None until
@@ -619,9 +648,28 @@ class InferenceServer:
                       seed_used=resolve_seed(sampling, self._host_rng,
                                              self._lock),
                       submit_time=time.perf_counter())
+        req._on_cancel = self._handle_cancel
         with self._lock:
             self._pending.append(req)
         return req
+
+    def _handle_cancel(self, req: Request) -> None:
+        """Client-thread half of Request.cancel(): pending requests
+        finish immediately; an active slot is reaped by the sweep at
+        the start of the next step()."""
+        with self._lock:
+            try:
+                self._pending.remove(req)
+            except ValueError:
+                return  # active: the step sweep owns the teardown
+        req.finish_reason = "cancelled"
+        req._done.set()
+
+    def _sweep_cancelled(self) -> None:
+        for slot, req in enumerate(self._slots):
+            if req is not None and req._cancel.is_set():
+                req.finish_reason = "cancelled"
+                self._finish(slot, req)
 
     def generate(self, prompts: Sequence[Sequence[int]], *,
                  max_new_tokens: int | None = None) -> list[list[int]]:
@@ -849,6 +897,7 @@ class InferenceServer:
         Thread-safe: concurrent callers serialise on an internal lock.
         """
         with self._step_lock:
+            self._sweep_cancelled()
             self._admit_pending()
             if self.num_active == 0:
                 return 0

@@ -71,6 +71,126 @@ def test_json_regex_accepts_and_rejects():
         assert not jd.matches(doc.encode()), doc
 
 
+ADDRESS_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string"},
+        "age": {"type": "integer"},
+        "tags": {"type": "array", "items": {"type": "string"},
+                 "maxItems": 3},
+        "kind": {"enum": ["a", "b", 3]},
+        "nested": {"type": "object",
+                   "properties": {"ok": {"type": "boolean"}},
+                   "required": ["ok"]},
+    },
+    "required": ["name", "age", "kind", "nested"],
+}
+
+
+def _schema_dfa(schema, **kw):
+    return grammar.compile_byte_dfa(grammar.json_schema_regex(schema,
+                                                              **kw))
+
+
+def test_json_schema_regex_accepts_valid():
+    dfa = _schema_dfa(ADDRESS_SCHEMA)
+    good = [
+        '{"name": "x", "age": 3, "kind": "a", "nested": {"ok": true}}',
+        '{"name":"", "age":-7, "tags":["t"], "kind":3,'
+        ' "nested":{"ok":false}}',
+        '{"name": "q", "age": 0, "tags": [], "kind": "b",'
+        ' "nested": {"ok": true}}',
+    ]
+    for doc in good:
+        assert dfa.matches(doc.encode()), doc
+        json.loads(doc)  # sanity: truly valid JSON
+
+
+def test_json_schema_regex_rejects_invalid():
+    dfa = _schema_dfa(ADDRESS_SCHEMA)
+    bad = [
+        '{"name": "x", "age": 3, "kind": "a"}',            # missing req
+        '{"age": 3, "name": "x", "kind": "a",'
+        ' "nested": {"ok": true}}',                        # wrong order
+        '{"name": "x", "age": 3.5, "kind": "a",'
+        ' "nested": {"ok": true}}',                        # float age
+        '{"name": "x", "age": 3, "kind": "c",'
+        ' "nested": {"ok": true}}',                        # bad enum
+        '{"name": "x", "age": 3, "kind": "a",'
+        ' "nested": {"ok": true}, "extra": 1}',            # closed world
+        '{"name": "x", "age": 3,'
+        ' "tags": ["a", "b", "c", "d"], "kind": "a",'
+        ' "nested": {"ok": true}}',                        # > maxItems
+    ]
+    for doc in bad:
+        assert not dfa.matches(doc.encode()), doc
+
+
+def test_json_schema_optional_combinations():
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"},
+                             "b": {"type": "integer"},
+                             "c": {"type": "integer"}},
+              "required": ["b"]}
+    dfa = _schema_dfa(schema)
+    assert dfa.matches(b'{"b": 1}')
+    assert dfa.matches(b'{"a": 1, "b": 2}')
+    assert dfa.matches(b'{"b": 1, "c": 2}')
+    assert dfa.matches(b'{"a": 1, "b": 2, "c": 3}')
+    assert not dfa.matches(b'{"a": 1}')          # missing required
+    assert not dfa.matches(b'{"b": 1, "a": 2}')  # order violated
+
+
+def test_json_schema_scalar_features():
+    assert _schema_dfa({"type": "string", "minLength": 2,
+                        "maxLength": 4}).matches(b'"abc"')
+    assert not _schema_dfa({"type": "string", "minLength": 2}
+                           ).matches(b'"a"')
+    # bare "items" implies array, symmetric with bare "properties"
+    arr = _schema_dfa({"items": {"type": "integer"}})
+    assert arr.matches(b"[1, 2]") and not arr.matches(b"3")
+    dfa = _schema_dfa({"anyOf": [{"type": "integer"},
+                                 {"type": "null"}]})
+    assert dfa.matches(b"42") and dfa.matches(b"null")
+    assert not dfa.matches(b'"x"')
+    assert _schema_dfa({"const": {"k": [1, "s"]}}).matches(
+        b'{"k":[1,"s"]}')
+    # string enum with regex metacharacters must be escaped
+    assert _schema_dfa({"enum": ["a+b", "c[d]"]}).matches(b'"a+b"')
+
+
+def test_json_schema_errors():
+    with pytest.raises(ValueError):  # unsupported keyword is loud
+        grammar.json_schema_regex({"type": "integer", "minimum": 3})
+    with pytest.raises(ValueError):  # nesting past max_depth
+        grammar.json_schema_regex(
+            {"type": "object", "properties": {
+                "a": {"type": "object", "properties": {
+                    "b": {"type": "integer"}}}}}, max_depth=1)
+    with pytest.raises(ValueError):  # too many optionals
+        grammar.json_schema_regex(
+            {"type": "object",
+             "properties": {f"k{i}": {"type": "integer"}
+                            for i in range(8)}})
+    with pytest.raises(ValueError):  # required key not declared
+        grammar.json_schema_regex(
+            {"type": "object", "properties": {}, "required": ["x"]})
+    with pytest.raises(ValueError, match="maxLength"):  # loud, named
+        grammar.json_schema_regex({"type": "string", "maxLength": 300})
+    with pytest.raises(ValueError, match="minItems"):
+        grammar.json_schema_regex({"type": "array", "minItems": 400})
+
+    # combinatorial blow-up: optional keys double the regex per key and
+    # compound across nesting — must trip the size cap bottom-up (cheap
+    # failure, bounded memory), not OOM building a multi-GB string
+    def nest(d):
+        props = {f"k{i}": ({"type": "integer"} if d == 0 else
+                           nest(d - 1)) for i in range(6)}
+        return {"type": "object", "properties": props}  # all optional
+    with pytest.raises(ValueError, match="regex over"):
+        grammar.json_schema_regex(nest(3), max_depth=8)
+
+
 def test_regex_errors():
     for pat in ["(", "a{3,2}", "[z-a]", "a{", "*a", "[]"]:
         with pytest.raises(ValueError):
@@ -275,6 +395,78 @@ def test_sampled_constrained_generation(params):
                                            temperature=1.5, seed=3))
     srv.run_until_idle()
     assert _valid(r"[ab]{3,8}", r.result())
+
+
+@pytest.mark.parametrize("spec_drafts", [0, 2])
+def test_schema_constrained_generation(params, spec_drafts):
+    """Generations under a compiled JSON Schema validate against it —
+    under sampling AND speculation. Completion (finish 'eos') implies
+    the document parses and satisfies the schema."""
+    schema = {"type": "object",
+              "properties": {"n": {"type": "integer"},
+                             "k": {"enum": ["x", "y"]}},
+              "required": ["n", "k"]}
+    pattern = grammar.json_schema_regex(schema)
+    srv = PagedInferenceServer(params, CFG, ICFG,
+                               spec_drafts=spec_drafts, **SRV_KW)
+    reqs = [srv.submit(TOK.encode(p), max_new_tokens=60,
+                       sampling=SamplingParams(regex=pattern,
+                                               temperature=0.9,
+                                               seed=5))
+            for p in ("give json", "x")]
+    srv.run_until_idle()
+    for r in reqs:
+        text = TOK.decode(r.result())
+        if r.finish_reason == "eos":
+            doc = json.loads(text)
+            assert isinstance(doc["n"], int) and doc["k"] in ("x", "y")
+            assert list(doc) == ["n", "k"]
+        else:
+            assert r.finish_reason == "length"
+
+
+def test_json_schema_over_http(params):
+    """OpenAI response_format json_schema end-to-end."""
+    from urllib import request as urq
+    from cloud_server_tpu.inference.http_server import HttpFrontend
+    srv = PagedInferenceServer(params, CFG, ICFG, **SRV_KW).start()
+    front = HttpFrontend(srv, tokenizer=TOK).start()
+    try:
+        host, port = front.address
+        body = json.dumps({
+            "prompt": "data:", "max_tokens": 60,
+            "response_format": {
+                "type": "json_schema",
+                "json_schema": {"name": "point", "schema": {
+                    "type": "object",
+                    "properties": {"x": {"type": "integer"},
+                                   "y": {"type": "integer"}},
+                    "required": ["x", "y"]}}}}).encode()
+        req = urq.Request(f"http://{host}:{port}/v1/completions",
+                          data=body)
+        with urq.urlopen(req, timeout=300) as resp:
+            out = json.loads(resp.read())
+        choice = out["choices"][0]
+        if choice["finish_reason"] == "stop":
+            doc = json.loads(choice["text"])
+            assert isinstance(doc["x"], int) and isinstance(doc["y"], int)
+        else:
+            assert choice["finish_reason"] == "length"
+        # a bad schema is a 400, not a handler crash
+        bad = json.dumps({
+            "prompt": "p", "response_format": {
+                "type": "json_schema",
+                "json_schema": {"schema": {"type": "integer",
+                                           "minimum": 1}}}}).encode()
+        import urllib.error as uerr
+        with pytest.raises(uerr.HTTPError) as ei:
+            urq.urlopen(urq.Request(
+                f"http://{host}:{port}/v1/completions", data=bad),
+                timeout=60)
+        assert ei.value.code == 400
+    finally:
+        front.stop()
+        srv.stop()
 
 
 def test_json_mode_over_http(params):

@@ -1,0 +1,227 @@
+"""Request lifecycle hardening on the paged server: client-side
+cancellation (pending / mid-admission / mid-decode), bounded pending
+queue (QueueFullError -> HTTP 429), streaming-client disconnect aborts,
+and graceful drain on stop."""
+
+import json
+import socket
+import time
+
+import jax
+import pytest
+
+from cloud_server_tpu.config import InferConfig, ModelConfig
+from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+from cloud_server_tpu.inference.server import QueueFullError
+from cloud_server_tpu.models import transformer
+
+CFG = ModelConfig(
+    vocab_size=64, embed_dim=32, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=8, mlp_dim=64, max_seq_len=256, dtype="float32",
+    param_dtype="float32", remat="none")
+GREEDY = InferConfig(max_decode_len=8, temperature=0.0, eos_token_id=-1,
+                     pad_token_id=0)
+SRV_KW = dict(max_slots=4, max_context=64, page_size=8, prefill_chunk=16,
+              prompt_buckets=[16, 32])
+
+PROMPT = [5, 9, 3]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+# ---------------------------------------------------------------------------
+# cancellation
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_pending_finishes_immediately(params):
+    """A request cancelled before admission completes on the CLIENT
+    thread — no scheduler step needed."""
+    srv = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    r = srv.submit(PROMPT, max_new_tokens=8)
+    r.cancel()
+    assert r.done and r.finish_reason == "cancelled"
+    assert srv.num_pending == 0
+    r.cancel()  # idempotent
+    # the server is unaffected: a fresh request still runs
+    ok = srv.submit(PROMPT, max_new_tokens=4)
+    srv.run_until_idle()
+    assert len(ok.result()) == 4
+
+
+def test_cancel_mid_decode_releases_pages(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    total = srv.allocator.available
+    r = srv.submit(list(range(1, 13)), max_new_tokens=30)
+    while not srv.active.any():  # admit fully, start decoding
+        srv.step()
+    srv.step()
+    assert not r.done
+    r.cancel()
+    srv.step()  # the sweep reaps it at the next scheduler round
+    assert r.done and r.finish_reason == "cancelled"
+    assert srv.num_active == 0
+    # every page is free or evictable-cached again
+    assert srv.allocator.available == total
+    assert 0 < len(r.tokens) < 30  # partial output is preserved
+
+
+def test_cancel_mid_admission(params):
+    """Cancelled while its chunked-prefill job is in flight: the job
+    completes its (already batched) chunks, but the slot releases
+    without ever activating and no token is emitted."""
+    srv = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    r = srv.submit(list(range(1, 29)), max_new_tokens=8)
+    srv.step()  # admission job started (prefill_chunk=16 < 28 tokens)
+    assert srv._jobs and not srv.active.any()
+    r.cancel()
+    srv.run_until_idle()
+    assert r.done and r.finish_reason == "cancelled"
+    assert r.tokens == []
+    assert srv.num_active == 0
+
+
+def test_cancel_done_request_is_noop(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    r = srv.submit(PROMPT, max_new_tokens=4)
+    srv.run_until_idle()
+    assert r.finish_reason == "length"
+    r.cancel()
+    assert r.finish_reason == "length"  # unchanged
+
+
+# ---------------------------------------------------------------------------
+# backpressure
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_queue_raises(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY, max_pending=2,
+                               **SRV_KW)
+    srv.submit(PROMPT, max_new_tokens=4)
+    srv.submit(PROMPT, max_new_tokens=4)
+    with pytest.raises(QueueFullError):
+        srv.submit(PROMPT, max_new_tokens=4)
+    # QueueFullError is retryable: after the queue shrinks, submit works
+    srv.run_until_idle()
+    r = srv.submit(PROMPT, max_new_tokens=4)
+    srv.run_until_idle()
+    assert len(r.result()) == 4
+
+
+def test_queue_full_maps_to_429(params):
+    from urllib import error as uerr
+    from urllib import request as urq
+    from cloud_server_tpu.inference.http_server import HttpFrontend
+    srv = PagedInferenceServer(params, CFG, GREEDY, max_pending=1,
+                               **SRV_KW)  # NOT started: queue stays full
+    front = HttpFrontend(srv).start()
+    try:
+        srv.submit(PROMPT, max_new_tokens=4)  # occupies the only seat
+        host, port = front.address
+        body = json.dumps({"prompt": PROMPT, "max_tokens": 4}).encode()
+        with pytest.raises(uerr.HTTPError) as ei:
+            urq.urlopen(urq.Request(
+                f"http://{host}:{port}/v1/completions", data=body),
+                timeout=30)
+        assert ei.value.code == 429
+    finally:
+        front.stop()
+
+
+# ---------------------------------------------------------------------------
+# streaming client disconnect
+# ---------------------------------------------------------------------------
+
+
+def test_disconnect_aborts_streaming_request(params):
+    """A streaming client that vanishes mid-generation must free its
+    slot long before max_tokens; the server keeps serving others."""
+    from cloud_server_tpu.inference.http_server import HttpFrontend
+    icfg = InferConfig(max_decode_len=200, temperature=0.0,
+                       eos_token_id=-1, pad_token_id=0)
+    srv = PagedInferenceServer(params, CFG, icfg, max_slots=4,
+                               max_context=256, page_size=8,
+                               decode_chunk=2, prefill_chunk=16,
+                               prompt_buckets=[16]).start()
+    front = HttpFrontend(srv).start()
+    try:
+        host, port = front.address
+        body = json.dumps({"prompt": PROMPT, "max_tokens": 200,
+                           "stream": True}).encode()
+        raw = (f"POST /v1/completions HTTP/1.1\r\nHost: {host}\r\n"
+               f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+        s = socket.create_connection((host, port), timeout=30)
+        s.sendall(raw)
+        s.recv(1024)  # first SSE bytes: generation is streaming
+        s.close()     # client walks away
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if srv.num_active == 0 and not srv._jobs:
+                break
+            time.sleep(0.05)
+        assert srv.num_active == 0
+        assert srv.tokens_emitted < 150  # aborted well before the end
+        # server still healthy
+        r = srv.submit(PROMPT, max_new_tokens=4)
+        assert len(r.result(timeout=120)) == 4
+    finally:
+        front.stop()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------------
+
+
+def test_stop_drain_completes_inflight(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    reqs = [srv.submit(PROMPT, max_new_tokens=6) for _ in range(3)]
+    srv.stop(drain=True)
+    for r in reqs:
+        assert r.finish_reason == "length"
+        assert len(r.tokens) == 6
+    with pytest.raises(RuntimeError):
+        srv.submit(PROMPT, max_new_tokens=2)
+
+
+def test_drain_timeout_returns_false(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    srv.submit(PROMPT, max_new_tokens=8)
+    assert srv.drain(timeout=0.0) is False  # nothing stepped yet
+    with pytest.raises(RuntimeError):  # draining refuses new work
+        srv.submit(PROMPT, max_new_tokens=2)
+
+
+def test_drain_with_background_thread(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW).start()
+    reqs = [srv.submit(PROMPT, max_new_tokens=6) for _ in range(2)]
+    assert srv.drain(timeout=120) is True
+    srv.stop()
+    for r in reqs:
+        assert len(r.tokens) == 6
+
+
+def test_contiguous_server_cancel(params):
+    """The contiguous server shares the cancel surface: pending finishes
+    immediately, active slots release at the next step."""
+    from cloud_server_tpu.inference.server import InferenceServer
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=2, max_len=64,
+                          prompt_buckets=[16])
+    pending = srv.submit(PROMPT, max_new_tokens=8)
+    pending.cancel()
+    assert pending.done and pending.finish_reason == "cancelled"
+    active = srv.submit(PROMPT, max_new_tokens=30)
+    srv.step()
+    assert not active.done
+    active.cancel()
+    srv.step()
+    assert active.done and active.finish_reason == "cancelled"
+    assert srv.num_active == 0
+    ok = srv.submit(PROMPT, max_new_tokens=4)
+    srv.run_until_idle()
+    assert len(ok.result()) == 4

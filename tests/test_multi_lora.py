@@ -260,3 +260,42 @@ def test_bad_shape_adapter_rejected_cleanly(params, adapters):
     r = srv.submit(PROMPTS[0], max_new_tokens=6, adapter="a")
     srv.run_until_idle()
     assert r.result() == _merged_ref(params, lp_a, cfg_a, PROMPTS[0], 6)
+
+
+def test_incremental_add_amortized(params):
+    """add() is O(one adapter) in the common case: capacity rows absorb
+    registrations without a full restack; an unseen target zero-stacks
+    in place; only capacity/rank exhaustion rebuilds (geometric, so
+    rebuilds amortize out)."""
+    from cloud_server_tpu.inference.multi_lora import AdapterSet
+    aset = AdapterSet(CFG)
+    for i in range(5):
+        lcfg = LoRAConfig(rank=2, alpha=4.0, targets=("wq",))
+        aset.add(f"ad{i}", _nonzero_lora(lcfg, 10 + i), lcfg)
+    # first add builds (cap 4); adds 2-3 fit; 4th grows to cap 8; 5th fits
+    assert aset.rebuilds == 2
+    wcfg = LoRAConfig(rank=2, alpha=4.0, targets=("wo",))
+    aset.add("wo_ad", _nonzero_lora(wcfg, 99), wcfg)
+    assert aset.rebuilds == 2  # unseen target: no rebuild
+    rcfg = LoRAConfig(rank=8, alpha=16.0, targets=("wq",))
+    aset.add("big", _nonzero_lora(rcfg, 123), rcfg)
+    assert aset.rebuilds == 3  # rank past headroom: one rebuild
+
+
+def test_many_adapters_each_matches_merged(params):
+    """Correctness across the grow/in-place admission paths: every one
+    of 5 sequentially-registered adapters (spanning both stack-growth
+    boundaries) still serves exactly its merged model."""
+    srv = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    regs = []
+    for i in range(5):
+        lcfg = LoRAConfig(rank=2 if i % 2 else 4, alpha=4.0,
+                          targets=("wq", "wv") if i % 2 else ("wo",))
+        lp = _nonzero_lora(lcfg, 50 + i)
+        srv.add_adapter(f"m{i}", lp, lcfg)
+        regs.append((f"m{i}", lp, lcfg))
+    for name, lp, lcfg in regs:
+        out = srv.submit(PROMPTS[1], max_new_tokens=6, adapter=name)
+        srv.run_until_idle()
+        assert out.result() == _merged_ref(params, lp, lcfg,
+                                           PROMPTS[1], 6), name

@@ -401,3 +401,25 @@ def test_eviction_under_churn(params):
         out = srv.generate([prompt], max_new_tokens=5)[0]
         assert out == _engine_reference(params, prompt, 5), i
     assert srv.allocator.evictions > 0
+
+
+def test_admit_decode_chunk_bounds_rounds(params):
+    """While an admission job is in flight, decode dispatches shrink to
+    admit_decode_chunk rounds (TTFT bound); full decode_chunk resumes
+    once admissions drain. None disables the shrink."""
+    long_prompt = list(range(1, 29))  # 2 chunks at prefill_chunk=16
+    for knob, during in ((1, 1), (2, 2), (None, 8)):
+        srv = PagedInferenceServer(params, CFG, GREEDY, decode_chunk=8,
+                                   admit_decode_chunk=knob, **SRV_KW)
+        # budget large enough that remaining-tokens never bounds the
+        # dispatch below decode_chunk during this test
+        r0 = srv.submit(PROMPTS[0], max_new_tokens=40)
+        while not srv.active.any():
+            srv.step()
+        assert not srv._jobs and srv._chunk_rounds() == 8
+        srv.submit(long_prompt, max_new_tokens=8)
+        srv.step()  # admission job started
+        assert srv._jobs
+        assert srv._chunk_rounds() == during, knob
+        srv.run_until_idle()
+        assert len(r0.result()) == 40
