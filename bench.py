@@ -47,6 +47,19 @@ Config notes (measured on TPU v5e, this repo):
     without a profiler through the tunnel (still blocked), the
     remaining levers are hand-fused pallas (qkv+rope+write, CE) whose
     plausible wins are single-digit ms each.
+  * r5 fused-CE kernel (ops/fused_ce.py, ce_impl="pallas" — now the
+    bench config): the r4-nominated CE lever, built and measured.
+    Decomposition first (benchmarks/step_decomposition.py): full step
+    220.0 = hidden fwd+bwd 189.1 + CE ~16.5 + optimizer 14.4; the
+    dense CE pays f32 d_logits matmul passes + ~4 GB of logits round
+    trips. Kernel A/B at the bench config
+    (benchmarks/fused_ce_bench.py): CE fwd+bwd 23.5 -> 18.7 ms, FULL
+    STEP 220.5 -> 214.3 ms (-2.8%) — the first move in the ~0.377 MFU
+    plateau in three rounds (-> ~0.390). Variants measured: two-kernel
+    bwd (dx + dW each recomputing logits) 22.0 ms; emitted-d single
+    recompute + XLA dW matmul 18.7 ms (kept); row tiles 512 19.7 ms
+    (256 kept); bwd vocab tiles 640 under the default 16 MB scoped
+    vmem 24.1 ms (3200 with vmem_limit_bytes=100MB kept).
 """
 
 from __future__ import annotations
@@ -138,7 +151,7 @@ def train_bench():
         vocab_size=32000, embed_dim=1024, num_layers=16, num_heads=16,
         num_kv_heads=16, head_dim=64, mlp_dim=4096, max_seq_len=1024,
         dtype="bfloat16", param_dtype="float32", remat="dots",
-        attention_impl="flash")
+        attention_impl="flash", ce_impl="pallas")
     batch, seq = 8, 1024
     train_cfg = TrainConfig(batch_size=batch, seq_len=seq, warmup_steps=10,
                             total_steps=100)

@@ -83,6 +83,16 @@ class ModelConfig:
     # rematerialised scan, so peak loss-path memory is (B, S, chunk) and
     # the ~1 GB logits tensor never hits HBM.
     vocab_chunk: int = 0
+    # Training-loss implementation:
+    #   "dense"  — materialise (B, S, V) f32 logits (XLA path);
+    #              vocab_chunk > 0 selects the scan-chunked variant.
+    #   "pallas" — ops/fused_ce.py kernels: online-logsumexp forward
+    #              (no logits in HBM), single-recompute backward whose
+    #              one (B*S, V) buffer is the MODEL-dtype d_logits —
+    #              half the dense path's f32 logits — with gradient
+    #              matmuls in the model dtype. Requires
+    #              logits_softcap == 0 and B*S, vocab divisible by 128.
+    ce_impl: str = "dense"
 
     def __post_init__(self) -> None:
         if self.num_heads % max(self.num_kv_heads, 1) != 0:
@@ -90,6 +100,16 @@ class ModelConfig:
                 f"num_heads={self.num_heads} must be a multiple of "
                 f"num_kv_heads={self.num_kv_heads}"
             )
+        if self.ce_impl not in ("dense", "pallas"):
+            raise ValueError(f"unknown ce_impl: {self.ce_impl!r}")
+        if self.ce_impl == "pallas" and self.logits_softcap != 0.0:
+            raise ValueError(
+                "ce_impl='pallas' does not implement logits_softcap; "
+                "use the dense/chunked CE path")
+        if self.ce_impl == "pallas" and self.vocab_chunk > 0:
+            raise ValueError(
+                "ce_impl='pallas' and vocab_chunk are mutually "
+                "exclusive CE implementations")
 
     @property
     def q_per_kv(self) -> int:

@@ -248,9 +248,9 @@ def next_token_loss(params: Params, batch: dict, cfg: ModelConfig,
                     router_z_coef: float = 0.0):
     seg = batch.get("segment_ids")
     batch = transformer.apply_segment_loss_mask(batch)
-    if cfg.vocab_chunk > 0:
+    if cfg.ce_impl == "pallas" or cfg.vocab_chunk > 0:
         x, aux = forward_hidden(params, batch["tokens"], cfg, segment_ids=seg)
-        loss, metrics = transformer.fused_cross_entropy(
+        loss, metrics = transformer.hidden_state_loss(
             x, params, batch, cfg, z_loss_coef)
     else:
         logits, aux = forward(params, batch["tokens"], cfg, segment_ids=seg)

@@ -432,15 +432,10 @@ def _chunked_logz_target_argmax(x, head, targets, cfg: ModelConfig):
     return m + jnp.log(l), tgt, bidx
 
 
-def fused_cross_entropy(x, params: Params, batch: dict, cfg: ModelConfig,
-                        z_loss_coef: float = 0.0):
-    """Next-token CE over final hidden states, chunked over the vocab.
-
-    Same contract/metrics as `masked_cross_entropy`, but consumes hidden
-    states (B, S, D) instead of logits. The shift is expressed by pairing
-    position i with target token i+1 and masking the last position, so the
-    sequence dim keeps its full (sp-divisible) length.
-    """
+def _shifted_targets_mask(batch: dict):
+    """The full-length next-token pairing both hidden-state CE impls
+    share: position i predicts token i+1; the last position is masked
+    out, so the sequence dim keeps its full (sp-divisible) length."""
     tokens = batch["tokens"]
     targets = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
     mask = batch.get("mask")
@@ -448,10 +443,13 @@ def fused_cross_entropy(x, params: Params, batch: dict, cfg: ModelConfig,
         mask.astype(jnp.float32))
     mask = jnp.concatenate(
         [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1)
+    return targets, mask
 
-    head = _unembed_head(params, cfg)
-    logz, target_logit, argmax_idx = _chunked_logz_target_argmax(
-        x, head, targets, cfg)
+
+def _stats_loss(logz, target_logit, argmax_idx, targets, mask,
+                z_loss_coef: float):
+    """(loss, metrics) from per-position CE statistics — the single
+    epilogue for every stats-producing CE implementation."""
     nll = logz - target_logit
     denom = jnp.maximum(mask.sum(), 1.0)
     loss = (nll * mask).sum() / denom
@@ -464,6 +462,54 @@ def fused_cross_entropy(x, params: Params, batch: dict, cfg: ModelConfig,
     return loss, metrics
 
 
+def fused_cross_entropy(x, params: Params, batch: dict, cfg: ModelConfig,
+                        z_loss_coef: float = 0.0):
+    """Next-token CE over final hidden states, chunked over the vocab
+    (lax.scan; see `_chunked_logz_target_argmax`). Same contract and
+    metrics as `masked_cross_entropy`."""
+    targets, mask = _shifted_targets_mask(batch)
+    head = _unembed_head(params, cfg)
+    logz, target_logit, argmax_idx = _chunked_logz_target_argmax(
+        x, head, targets, cfg)
+    return _stats_loss(logz, target_logit, argmax_idx, targets, mask,
+                       z_loss_coef)
+
+
+def pallas_cross_entropy(x, params: Params, batch: dict,
+                         cfg: ModelConfig, z_loss_coef: float = 0.0):
+    """Next-token CE via the fused pallas kernels (ops/fused_ce.py):
+    same contract and metrics as `fused_cross_entropy`, but the
+    per-row (logz, target_logit, argmax) statistics come out of an
+    online-logsumexp kernel — no f32 logits in HBM, and the backward's
+    matmuls run in the model dtype (its one (B*S, V) buffer is the
+    model-dtype d_logits; see ops/fused_ce.py)."""
+    from cloud_server_tpu.ops.fused_ce import fused_ce_stats
+
+    b, s = batch["tokens"].shape
+    targets, mask = _shifted_targets_mask(batch)
+    head = _unembed_head(params, cfg).astype(cfg.dtype)
+    logz, target_logit, argmax_idx = fused_ce_stats(
+        x.reshape(b * s, -1), head, targets.reshape(-1))
+    return _stats_loss(logz.reshape(b, s), target_logit.reshape(b, s),
+                       argmax_idx.reshape(b, s), targets, mask,
+                       z_loss_coef)
+
+
+def hidden_state_loss(x, params: Params, batch: dict, cfg: ModelConfig,
+                      z_loss_coef: float = 0.0):
+    """Next-token CE from final hidden states — THE dispatch point for
+    every hidden-state loss path (dense stack, MoE, pipelined), so a
+    ce_impl/vocab_chunk setting can never be silently ignored by one
+    of them: ce_impl='pallas' -> fused kernels; vocab_chunk > 0 ->
+    scan-chunked; else dense unembed + masked CE."""
+    if cfg.ce_impl == "pallas":
+        return pallas_cross_entropy(x, params, batch, cfg, z_loss_coef)
+    if cfg.vocab_chunk > 0:
+        return fused_cross_entropy(x, params, batch, cfg, z_loss_coef)
+    logits = unembed(x, params, cfg)
+    return masked_cross_entropy(logits, batch, z_loss_coef)
+
+
 def next_token_loss(params: Params, batch: dict, cfg: ModelConfig,
                     z_loss_coef: float = 0.0):
     """Causal LM loss. batch: {"tokens": (B, S) int32, optional
@@ -472,15 +518,17 @@ def next_token_loss(params: Params, batch: dict, cfg: ModelConfig,
     Predicts tokens[:, 1:] from tokens[:, :-1]. Forward runs on the full S
     (not S-1) so the sequence stays divisible for sp-sharded attention; the
     last position is dropped inside the loss. With cfg.vocab_chunk > 0 the
-    logits never materialise (see `fused_cross_entropy`). With
-    segment_ids, attention/positions follow the packing (see
-    `forward_hidden`) and targets crossing a document boundary (or in
-    padding) are masked out of the loss.
+    logits never materialise (see `fused_cross_entropy`); with
+    cfg.ce_impl == "pallas" they never do either, via the fused kernels
+    (see `pallas_cross_entropy`). With segment_ids,
+    attention/positions follow the packing (see `forward_hidden`) and
+    targets crossing a document boundary (or in padding) are masked out
+    of the loss.
     """
     seg = batch.get("segment_ids")
     batch = apply_segment_loss_mask(batch)
-    if cfg.vocab_chunk > 0:
+    if cfg.ce_impl == "pallas" or cfg.vocab_chunk > 0:
         x = forward_hidden(params, batch["tokens"], cfg, segment_ids=seg)
-        return fused_cross_entropy(x, params, batch, cfg, z_loss_coef)
+        return hidden_state_loss(x, params, batch, cfg, z_loss_coef)
     logits = forward(params, batch["tokens"], cfg, segment_ids=seg)
     return masked_cross_entropy(logits, batch, z_loss_coef)

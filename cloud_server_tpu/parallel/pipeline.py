@@ -303,10 +303,11 @@ def make_pipelined_loss(model_cfg, mesh: Mesh, num_microbatches: int,
     """Pipelined replacement for <module>.next_token_loss; same signature
     (params, batch, cfg) so it drops into make_train_step(loss_fn=...).
 
-    Honors cfg.vocab_chunk: with vocab_chunk > 0 the loss runs blockwise
-    over the vocab (transformer.fused_cross_entropy) instead of
-    materialising (B, S, V) logits. With loss_fn_module=models.moe the MoE
-    stack pipelines and the router aux losses match moe.next_token_loss.
+    Honors cfg.vocab_chunk and cfg.ce_impl (transformer.
+    hidden_state_loss is the single dispatch point): chunked or fused
+    CE instead of materialising (B, S, V) logits. With
+    loss_fn_module=models.moe the MoE stack pipelines and the router
+    aux losses match moe.next_token_loss.
     """
     hidden = make_pipelined_hidden(model_cfg, mesh, num_microbatches,
                                    loss_fn_module=loss_fn_module)
@@ -321,13 +322,9 @@ def make_pipelined_loss(model_cfg, mesh: Mesh, num_microbatches: int,
         batch = transformer.apply_segment_loss_mask(batch)
         out = hidden(params, batch["tokens"], seg)
         x, aux = out if is_moe else (out, None)
-        if model_cfg.vocab_chunk > 0:
-            loss, metrics = transformer.fused_cross_entropy(
-                x, params, batch, model_cfg, z_loss_coef)
-        else:
-            logits = transformer.unembed(x, params, model_cfg)
-            loss, metrics = transformer.masked_cross_entropy(
-                logits, batch, z_loss_coef)
+        # single CE dispatch point: honors ce_impl AND vocab_chunk
+        loss, metrics = transformer.hidden_state_loss(
+            x, params, batch, model_cfg, z_loss_coef)
         if is_moe:
             metrics.update(load_balance=aux["load_balance"],
                            router_z=aux["router_z"],
