@@ -461,7 +461,9 @@ def _trained_spec_bench():
     lives (weights-streaming-bound, window nearly free); this section's
     job is the acceptance evidence the r3 bench lacked: a TRAINED model
     on natural held-out text (r4 measured: n-gram 1.64, draft-model
-    2.63 committed tokens/round)."""
+    2.63 committed tokens/round; r5, run standalone outside the
+    driver's time budget: n-gram 1.58, draft-model 2.78 — stable
+    round-over-round)."""
     import dataclasses
     import glob as _glob
 

@@ -815,8 +815,6 @@ class PagedInferenceServer:
                adapter: str | None = None) -> Request:
         if self._stop.is_set():
             raise RuntimeError("server is stopped; not accepting requests")
-        if self._draining:
-            raise RuntimeError("server is draining; not accepting requests")
         if (adapter is not None
                 and self.adapters.adapter_id(adapter) is None):
             raise ValueError(
@@ -847,6 +845,12 @@ class PagedInferenceServer:
                       submit_time=time.perf_counter())
         req._on_cancel = self._handle_cancel  # before it can be seen
         with self._lock:
+            # under the lock: drain() flips _draining under the same
+            # lock, so a submit either lands before drain observes the
+            # queue or is rejected — never appended-then-abandoned
+            if self._draining:
+                raise RuntimeError(
+                    "server is draining; not accepting requests")
             if (self.max_pending is not None
                     and len(self._pending) >= self.max_pending):
                 raise QueueFullError(
@@ -1482,6 +1486,7 @@ class PagedInferenceServer:
 
     def start(self) -> "PagedInferenceServer":
         self._stop.clear()
+        self._draining = False  # a stopped-then-restarted server serves
         self._thread = threading.Thread(target=self.serve_forever,
                                         daemon=True,
                                         name="paged-inference-server")
@@ -1490,11 +1495,13 @@ class PagedInferenceServer:
 
     def drain(self, timeout: float | None = None) -> bool:
         """Graceful drain: refuse new submissions, let everything
-        already accepted run to completion. Returns True once idle,
-        False if `timeout` seconds pass first (requests keep running —
-        the caller decides whether to stop() anyway). Safe with or
+        already accepted run to completion. Returns True once idle. On
+        timeout returns False and RESUMES accepting (the in-flight work
+        keeps running; call stop() to actually shut down — it fails
+        whatever is still live so no waiter hangs). Safe with or
         without the background scheduler thread."""
-        self._draining = True
+        with self._lock:
+            self._draining = True
         deadline = (None if timeout is None
                     else time.perf_counter() + timeout)
 
@@ -1503,6 +1510,8 @@ class PagedInferenceServer:
 
         while busy():
             if deadline is not None and time.perf_counter() > deadline:
+                with self._lock:
+                    self._draining = False
                 return False
             if self._thread is None:
                 self.step()
@@ -1518,3 +1527,10 @@ class PagedInferenceServer:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self.num_pending or self.num_active or self._jobs:
+            # a timed-out (or skipped) drain left live requests behind:
+            # nothing will ever step them now — unblock their waiters
+            # (_fail_all drops page refs without caching them, which is
+            # the conservative teardown for possibly-mid-write KV)
+            self._fail_all(RuntimeError(
+                "server stopped before the request completed"))

@@ -524,7 +524,7 @@ class InferenceServer:
     def __init__(self, params, cfg: ModelConfig, infer_cfg: InferConfig, *,
                  max_slots: int = 8, max_len: int = 1024,
                  prompt_buckets: Sequence[int] | None = None, seed: int = 0,
-                 decode_chunk: int = 1,
+                 decode_chunk: int = 1, max_pending: int | None = None,
                  prefix_tokens: Sequence[int] | None = None,
                  prefix_remainder_cap: int = 1024):
         # Serving never needs f32 master weights: pre-cast float32 leaves to
@@ -604,6 +604,10 @@ class InferenceServer:
             self._rem_buckets = ([b for b in self.prompt_buckets
                                   if b < rcap] + [rcap])
         self.tokens_emitted = 0  # lifetime emitted tokens (bench/metrics)
+        # backpressure: submit() past this bound raises QueueFullError
+        # (HTTP 429); None = unbounded (library use, trusted callers)
+        self.max_pending = max_pending
+        self._draining = False
         self._slots: list[Request | None] = [None] * max_slots
         self._pending: collections.deque[Request] = collections.deque()
         self._lock = threading.Lock()
@@ -650,6 +654,17 @@ class InferenceServer:
                       submit_time=time.perf_counter())
         req._on_cancel = self._handle_cancel
         with self._lock:
+            # under the lock: drain() flips _draining under the same
+            # lock, so a submit either lands before drain observes the
+            # queue or is rejected — never appended-then-abandoned
+            if self._draining:
+                raise RuntimeError(
+                    "server is draining; not accepting requests")
+            if (self.max_pending is not None
+                    and len(self._pending) >= self.max_pending):
+                raise QueueFullError(
+                    f"pending queue is full ({self.max_pending} "
+                    "requests); retry later")
             self._pending.append(req)
         return req
 
@@ -960,15 +975,46 @@ class InferenceServer:
             if busy == 0 and self.num_pending == 0:
                 self._stop.wait(idle_sleep_s)
 
-    def start(self) -> "InferenceServer":
-        self._stop.clear()
-        self._thread = threading.Thread(target=self.serve_forever,
-                                        daemon=True, name="inference-server")
-        self._thread.start()
-        return self
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful drain: refuse new submissions, let everything
+        already accepted finish. Returns True once idle. On timeout
+        returns False and RESUMES accepting (the in-flight work keeps
+        running; call stop() to actually shut down — it fails whatever
+        is still live so no waiter hangs). Same contract as the paged
+        server's."""
+        with self._lock:
+            self._draining = True
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        while self.num_pending or self.num_active:
+            if deadline is not None and time.perf_counter() > deadline:
+                with self._lock:
+                    self._draining = False
+                return False
+            if self._thread is None:
+                self.step()
+            else:
+                time.sleep(0.002)
+        return True
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = False,
+             timeout: float | None = None) -> None:
+        if drain and not self._stop.is_set():
+            self.drain(timeout)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self.num_pending or self.num_active:
+            # a timed-out (or skipped) drain left live requests behind:
+            # nothing will ever step them now — unblock their waiters
+            self._fail_all(RuntimeError(
+                "server stopped before the request completed"))
+
+    def start(self) -> "InferenceServer":
+        self._stop.clear()
+        self._draining = False  # a stopped-then-restarted server serves
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True, name="inference-server")
+        self._thread.start()
+        return self

@@ -189,11 +189,18 @@ def test_stop_drain_completes_inflight(params):
         srv.submit(PROMPT, max_new_tokens=2)
 
 
-def test_drain_timeout_returns_false(params):
+def test_drain_timeout_resumes_then_stop_unblocks(params):
     srv = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
-    srv.submit(PROMPT, max_new_tokens=8)
+    r = srv.submit(PROMPT, max_new_tokens=8)
     assert srv.drain(timeout=0.0) is False  # nothing stepped yet
-    with pytest.raises(RuntimeError):  # draining refuses new work
+    # a timed-out drain RESUMES accepting — the caller chose not to die
+    r2 = srv.submit(PROMPT, max_new_tokens=2)
+    # stop() without finishing them must fail the stragglers, not hang
+    # their waiters
+    srv.stop()
+    assert r.done and r.finish_reason.startswith("error")
+    assert r2.done and r2.finish_reason.startswith("error")
+    with pytest.raises(RuntimeError, match="stopped"):
         srv.submit(PROMPT, max_new_tokens=2)
 
 
@@ -225,3 +232,21 @@ def test_contiguous_server_cancel(params):
     ok = srv.submit(PROMPT, max_new_tokens=4)
     srv.run_until_idle()
     assert len(ok.result()) == 4
+
+
+def test_contiguous_server_backpressure_and_drain(params):
+    """max_pending and stop(drain=True) behave identically on the
+    contiguous server (shared lifecycle contract)."""
+    from cloud_server_tpu.inference.server import InferenceServer
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=2, max_len=64,
+                          prompt_buckets=[16], max_pending=1)
+    srv.submit(PROMPT, max_new_tokens=4)
+    with pytest.raises(QueueFullError):
+        srv.submit(PROMPT, max_new_tokens=4)
+    srv.run_until_idle()
+    reqs = [srv.submit(PROMPT, max_new_tokens=6)]
+    srv.stop(drain=True)
+    assert reqs[0].finish_reason == "length"
+    assert len(reqs[0].tokens) == 6
+    with pytest.raises(RuntimeError, match="stopped"):
+        srv.submit(PROMPT, max_new_tokens=2)
