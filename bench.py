@@ -357,17 +357,48 @@ def serving_bench():
 
 
 def _admission_churn_bench(params, base, infer_cfg):
-    """Continuous batching under churn: requests arrive in waves while
-    others decode — admissions (chunked prefill) interleave with decode
-    dispatches.
+    """Continuous batching under churn, A/B over the scheduler: requests
+    arrive in waves while others decode. "alternating" runs admissions
+    (chunked prefill) as separate dispatches interleaved with decode
+    dispatches; "mixed" fuses both into one token-budget dispatch per
+    iteration (stall-free scheduling — the r6 tentpole).
 
-    The scenario runs TWICE: once untimed to compile every dispatch
+    Each scenario runs TWICE: once untimed to compile every dispatch
     shape it triggers (r3's churn_tok_s=2.4 timed ~370 s of remote
     Mosaic compiles, not serving), then timed with all shapes warm.
-    Reports completed-token throughput, interleaved-decode count, and
-    the request-level latencies chunked prefill exists to bound: TTFT
-    for the long prompts that land mid-decode, and inter-token-latency
-    percentiles for the requests decoding while those admissions run."""
+    Reports completed-token throughput, interleaved-decode count, the
+    decode throughput SUSTAINED WHILE ADMISSIONS RUN (the number mixed
+    scheduling exists to lift — alternating r5 landed only 10 decode
+    steps across the whole admission phase), and the request-level
+    latencies chunked prefill exists to bound: TTFT for the long
+    prompts that land mid-decode, and inter-token-latency percentiles
+    for the requests decoding while those admissions run.
+
+    The headline `churn_*` keys are the MIXED run (the default
+    scheduler); `churn_*_alternating` / `churn_*_mixed` carry the A/B
+    and `churn_mixed_speedup` the ratio."""
+    out = {}
+    for sched in ("alternating", "mixed"):
+        res = _churn_scenario(params, base, infer_cfg, sched)
+        out.update({f"{k}_{sched}": v for k, v in res.items()})
+        print(f"[serving_bench] {sched}: churn_tok_s "
+              f"{res['churn_tok_s']:.1f} decode_tok_s_during_admission "
+              f"{res['churn_decode_tok_s_during_admission']:.1f} "
+              f"ttft_ms p50/p95: {res['churn_ttft_ms_p50']:.0f}/"
+              f"{res['churn_ttft_ms_p95']:.0f} "
+              f"itl_ms p50/p99: {res['churn_itl_ms_p50']:.1f}/"
+              f"{res['churn_itl_ms_p99']:.1f}", flush=True)
+        if sched == "mixed":
+            out.update(res)  # headline keys = the default scheduler
+    out["churn_mixed_speedup"] = (out["churn_tok_s_mixed"]
+                                  / max(out["churn_tok_s_alternating"],
+                                        1e-9))
+    print(f"[serving_bench] churn_mixed_speedup: "
+          f"{out['churn_mixed_speedup']:.2f}x", flush=True)
+    return out
+
+
+def _churn_scenario(params, base, infer_cfg, scheduler):
     import dataclasses
 
     import numpy as np
@@ -383,7 +414,7 @@ def _admission_churn_bench(params, base, infer_cfg):
         srv = PagedInferenceServer(
             params, cfg, infer_cfg, max_slots=16, max_context=1024,
             page_size=128, prefill_chunk=256, decode_chunk=8,
-            prompt_buckets=[64, 256, 512])
+            prompt_buckets=[64, 256, 512], scheduler=scheduler)
         rng = np.random.RandomState(0)
 
         def mk_prompt(n):
@@ -395,6 +426,8 @@ def _admission_churn_bench(params, base, infer_cfg):
             srv.step()
         t0 = time.perf_counter()
         interleaved = 0
+        dec_tok_adm = 0      # first-batch tokens landed while admitting
+        t_adm = 0.0          # wall time of admitting steps
         waves = []
         # three waves of long-prompt arrivals while the first batch decodes
         for _ in range(3):
@@ -402,16 +435,22 @@ def _admission_churn_bench(params, base, infer_cfg):
                       for _ in range(4)]
             for _ in range(6):
                 admitting = bool(srv._jobs) or srv.num_pending > 0
+                n0 = sum(len(r.tokens) for r in first)
+                ts = time.perf_counter()
                 srv.step()
-                if admitting and srv.active.any():
-                    interleaved += 1
+                te = time.perf_counter()
+                if admitting:
+                    t_adm += te - ts
+                    dec_tok_adm += sum(len(r.tokens) for r in first) - n0
+                    if srv.active.any():
+                        interleaved += 1
         srv.run_until_idle()
         dt = time.perf_counter() - t0
         srv.stop()
-        return first, waves, dt, interleaved
+        return first, waves, dt, interleaved, dec_tok_adm, t_adm
 
     scenario()  # warm-up: every prefill/decode shape compiles here
-    first, waves, dt, interleaved = scenario()
+    first, waves, dt, interleaved, dec_tok_adm, t_adm = scenario()
 
     total = sum(len(r.tokens) for r in first + waves)
 
@@ -424,18 +463,14 @@ def _admission_churn_bench(params, base, infer_cfg):
     itls = []
     for r in first:
         itls += [b - a for a, b in zip(r.emit_times, r.emit_times[1:])]
-    out = {"churn_tok_s": total / dt,
-           "churn_decode_steps_during_admission": interleaved,
-           "churn_ttft_ms_p50": pct(ttfts, 0.50) * 1e3,
-           "churn_ttft_ms_p95": pct(ttfts, 0.95) * 1e3,
-           "churn_itl_ms_p50": pct(itls, 0.50) * 1e3,
-           "churn_itl_ms_p99": pct(itls, 0.99) * 1e3}
-    print(f"[serving_bench] churn_tok_s: {out['churn_tok_s']:.1f} "
-          f"ttft_ms p50/p95: {out['churn_ttft_ms_p50']:.0f}/"
-          f"{out['churn_ttft_ms_p95']:.0f} "
-          f"itl_ms p50/p99: {out['churn_itl_ms_p50']:.1f}/"
-          f"{out['churn_itl_ms_p99']:.1f}", flush=True)
-    return out
+    return {"churn_tok_s": total / dt,
+            "churn_decode_steps_during_admission": interleaved,
+            "churn_decode_tok_s_during_admission":
+                dec_tok_adm / max(t_adm, 1e-9),
+            "churn_ttft_ms_p50": pct(ttfts, 0.50) * 1e3,
+            "churn_ttft_ms_p95": pct(ttfts, 0.95) * 1e3,
+            "churn_itl_ms_p50": pct(itls, 0.50) * 1e3,
+            "churn_itl_ms_p99": pct(itls, 0.99) * 1e3}
 
 
 def _trained_spec_bench():

@@ -181,6 +181,25 @@ class InferConfig:
     top_p: float = 1.0  # 1.0 => disabled
     eos_token_id: int = -1  # -1 => never stop early
     pad_token_id: int = 0
+    # Paged-server scheduling under admission churn (the contiguous
+    # server ignores both; PagedInferenceServer constructor arguments of
+    # the same names override these defaults):
+    #   "mixed" — stall-free token-budget batching: chunked prefills
+    #     piggyback on decode batches in one ragged dispatch, so decode
+    #     never stalls behind an admission (Sarathi-style).
+    #   "alternating" — separate prefill-chunk and decode dispatches
+    #     per scheduler step (the pre-mixed behavior; the fallback).
+    scheduler: str = "mixed"
+    # Tokens per mixed iteration: all live decode rows (times their
+    # round count) plus however many prefill-chunk tokens fit. 0 = auto:
+    # max_slots * (decode window * decode_chunk + prefill_chunk) —
+    # effectively work-conserving; set lower to trade admission speed
+    # for a per-iteration latency (ITL) bound.
+    mixed_token_budget: int = 0
+
+    def __post_init__(self) -> None:
+        if self.scheduler not in ("mixed", "alternating"):
+            raise ValueError(f"unknown scheduler: {self.scheduler!r}")
 
 
 def to_json(cfg: Any) -> str:

@@ -94,6 +94,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="paged server: admission window width — long "
                    "prompts prefill in chunks this wide, interleaved with "
                    "decode dispatches so inter-token latency stays bounded")
+    p.add_argument("--scheduler", choices=["mixed", "alternating"],
+                   default="mixed",
+                   help="paged server scheduling under admission churn: "
+                   "'mixed' (default) fuses chunked prefills and decode "
+                   "rows into one token-budget dispatch per iteration "
+                   "(stall-free — decodes advance during every prefill); "
+                   "'alternating' keeps separate prefill and decode "
+                   "dispatches (the pre-mixed behavior)")
+    p.add_argument("--mixed-token-budget", type=int, default=0,
+                   help="mixed scheduler: tokens per fused iteration "
+                   "(decode rows first, prefill fills the rest; 0 = auto: "
+                   "max_slots * (decode window * decode_chunk + "
+                   "prefill_chunk), i.e. work-conserving — set lower to "
+                   "trade admission speed for a per-iteration ITL bound)")
     p.add_argument("--allocation", choices=["ondemand", "reserve"],
                    default="ondemand",
                    help="paged server page policy: 'ondemand' grows "
@@ -325,6 +339,8 @@ def main(argv=None) -> None:
             spec_drafts=spec,
             prefill_chunk=prefill_chunk, seed=args.seed,
             allocation=args.allocation,
+            scheduler=args.scheduler,
+            mixed_token_budget=args.mixed_token_budget,
             draft_params=draft_params, draft_cfg=draft_cfg,
             tokenizer=tok)  # regex-constrained requests compile vs it
 

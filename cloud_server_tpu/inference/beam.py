@@ -52,6 +52,15 @@ def beam_search(params, prompt: jnp.ndarray, *, cfg: ModelConfig,
     ragged). Returns (tokens (B, k, max_new) int32 padded past EOS,
     scores (B, k) f32), best-first per prompt."""
     b, p = prompt.shape
+    if 2 * k > cfg.vocab_size:
+        # the 2k-candidate selection needs 2k distinct continuations of
+        # ONE live beam at t=0 (the other k-1 start at NEG_INF): with
+        # 2k > V, lax.top_k would select dead-beam NEG_INF candidates
+        # and return duplicate/garbage hypotheses with no error
+        raise ValueError(
+            f"beam width k={k} needs 2*k <= vocab_size "
+            f"({cfg.vocab_size}); the top-2k candidate selection "
+            "breaks for tiny vocabularies")
     max_len = max_len or (p + max_new)
     if max_len < p + max_new:
         raise ValueError(f"max_len={max_len} < prompt + max_new")

@@ -18,6 +18,10 @@ dense XLA elsewhere). The server's flows are just widths:
   * prefill / chunked prefill / prefix-cache continuation: W = chunk,
     with per-slot start offsets carried by `lengths` (a slot resuming
     after `n` shared-prefix tokens simply starts at lengths=n)
+  * MIXED batch (stall-free scheduling): per-row `widths` — decode rows
+    (width 1 or drafts+1) and prefill-chunk rows (width chunk) share ONE
+    ragged dispatch; writes past a row's width drop, attention anchors
+    each row at its own width (ops.paged_attention ragged rule)
 
 `window_forward` does NOT advance `lengths` — the caller commits however
 many window positions survive (sampling, speculative acceptance), exactly
@@ -200,7 +204,7 @@ def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
                    all_logits: bool = False,
                    pages_per_block: int | None = None,
                    mesh=None, tp_axis: str = "tp",
-                   lora=None, aid=None):
+                   lora=None, aid=None, widths: jnp.ndarray | None = None):
     """Forward W new positions per slot against the paged cache.
 
     Args:
@@ -212,6 +216,13 @@ def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
         needs one sampled position per chunk, never the (B, W, V) tensor.
       all_logits: return (B, W, V) f32 (speculative verification).
         With neither, returns None (interior prefill chunks).
+      widths: optional (B,) int32 — per-row VALID window widths for
+        ragged mixed batches. Positions at window index >= widths[b]
+        neither write kv nor anchor attention: their writes drop (the
+        page-table scatter masks them) and attention treats row b's
+        window as [lengths[b], lengths[b] + widths[b]) exactly as a
+        width-widths[b] uniform dispatch would. Rows with width 0 are
+        fully inert (sentinel-table discipline still applies on top).
       lora, aid: multi-adapter serving — (stacks, scales) from
         inference.multi_lora.AdapterSet.device_args + per-slot adapter
         ids (B,); each layer gathers its per-row (a, b, scale) and the
@@ -228,6 +239,12 @@ def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
     """
     b, w = tokens.shape
     pos = cache.lengths[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    # ragged rows: positions past a row's width write nowhere (pos -1
+    # never matches a page slot in _write_window, so the page merge is an
+    # identity rewrite of the row's own private pages — shared pages are
+    # never touched because writes start at lengths >= private start)
+    wpos = pos if widths is None else jnp.where(
+        jnp.arange(w, dtype=jnp.int32)[None, :] < widths[:, None], pos, -1)
     cos, sin = rope_table(cfg, cache.max_context)
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]  # (B, W, D)
 
@@ -237,7 +254,7 @@ def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
         # wider windows leave less VMEM for the double-buffered page
         # blocks; 8 pages measured fastest at W=1 on v5e
         pages_per_block = 8 if w <= 8 else 4
-    lens_after = cache.lengths + w
+    lens_after = cache.lengths + (w if widths is None else widths)
 
     for layer_idx in range(cfg.num_layers):
         lp = jax.tree.map(lambda p: p[layer_idx], params["layers"])
@@ -245,23 +262,26 @@ def window_forward(params, tokens: jnp.ndarray, cfg: ModelConfig,
               else multi_lora.layer_lora(lora, aid, layer_idx))
         q, k, v = transformer.attention_qkv(x, lp, cfg, cos, sin, pos,
                                             lora=ll)
-        cache = _write_window(cache, layer_idx, k, v, pos)
+        cache = _write_window(cache, layer_idx, k, v, wpos)
         if use_pallas:
             if mesh is not None and mesh.shape.get(tp_axis, 1) > 1:
                 o = paged_attention_tp(
                     q, cache.k, cache.v, lens_after, cache.tables,
                     layer_idx, mesh=mesh, axis_name=tp_axis,
                     pages_per_block=pages_per_block,
-                    k_scale_pool=cache.k_scale, v_scale_pool=cache.v_scale)
+                    k_scale_pool=cache.k_scale, v_scale_pool=cache.v_scale,
+                    widths=widths)
             else:
                 o = paged_attention(
                     q, cache.k, cache.v, lens_after, cache.tables,
                     layer_idx, pages_per_block=pages_per_block,
-                    k_scale_pool=cache.k_scale, v_scale_pool=cache.v_scale)
+                    k_scale_pool=cache.k_scale, v_scale_pool=cache.v_scale,
+                    widths=widths)
         else:
             o = paged_attention_xla(
                 q, cache.k, cache.v, lens_after, cache.tables, layer_idx,
-                k_scale_pool=cache.k_scale, v_scale_pool=cache.v_scale)
+                k_scale_pool=cache.k_scale, v_scale_pool=cache.v_scale,
+                widths=widths)
         x = transformer.attention_out(x, o, lp, cfg, lora=ll)
         x = _mlp_apply(x, lp, cfg, lora=ll)
 

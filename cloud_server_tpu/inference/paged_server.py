@@ -15,10 +15,32 @@ the contiguous slot cache). What the paged design buys:
     No server-lifetime single prefix; the cache is learned from traffic
     and LRU-evicted under memory pressure (inference/block_allocator.py).
   * Chunked prefill: admissions run as a sequence of bounded window
-    dispatches (`prefill_chunk` tokens each) interleaved with decode
-    steps, so one long prompt never stalls active decodes for its whole
-    prefill — inter-token latency stays bounded (the serving bench
-    measures it).
+    dispatches (`prefill_chunk` tokens each), so one long prompt never
+    stalls active decodes for its whole prefill — inter-token latency
+    stays bounded (the serving bench measures it).
+  * STALL-FREE MIXED BATCHING (scheduler="mixed", the default): while
+    any admission is in flight, each scheduler iteration fuses ONE
+    ragged prefill group (every admitting slot the token budget
+    selected, each at its own width — no remainder-bucket grouping) and
+    the full multi-round decode dispatch into a single jitted program
+    with a single host sync. The alternating scheduler (kept as
+    scheduler="alternating", and used automatically under draft-model
+    speculation) instead pays one dispatch + sync per admission group
+    plus one per decode dispatch, and shrinks decode to
+    `admit_decode_chunk` rounds whenever admissions are running — which
+    is exactly the churn cliff the r5 bench measured (decode collapsing
+    to ~10 steps across a whole admission phase). Greedy and seeded
+    outputs are token-for-token identical under both schedulers
+    (tests/test_mixed_scheduler.py). `mixed_token_budget` caps the
+    tokens packed per iteration (decode rows first, prefill fills the
+    rest, one minimal chunk guaranteed so TTFT stays bounded); the
+    default is work-conserving.
+  * Decode batch COMPACTION (both schedulers): decode dispatches carry
+    one row per LIVE slot (pow2-padded) with a slot_ids indirection
+    into the per-slot device state, so attention gathers and matmuls
+    scale with occupancy instead of max_slots — a half-admitted batch
+    no longer pays full-batch decode cost. Fully-live batches skip the
+    indirection entirely (the pre-compaction program).
   * Speculative decoding IS the decode loop (spec_drafts > 0): per-slot
     n-gram proposals drafted on device from each slot's token history,
     verified batch-wide in one W = drafts+1 window, committed per slot
@@ -96,6 +118,58 @@ def _pad_pow2(n: int) -> int:
     return p
 
 
+# Neutral per-field fills for PADDING rows of a gathered SamplingRows
+# (temp 0 = greedy, rep/top_p 1, bias slots out-of-vocab): padding
+# samples are discarded, but rep=0 would divide to inf/NaN and trip
+# jax_debug_nans even on discarded rows. Fields absent here fill with 0.
+_SAMP_PAD_FILLS = {"top_p": 1.0, "rep": 1.0,
+                   "bias_ids": sampling._BIAS_PAD}
+
+
+def _gather_samp_rows(samp_rows, idx, n_real):
+    """Per-slot SamplingRows rows gathered at `idx` (pre-clipped), with
+    rows past n_real overwritten by the neutral pad fills."""
+    out = []
+    for name, dst in zip(SamplingRows._fields, samp_rows):
+        rows = dst[idx].copy()
+        rows[n_real:] = _SAMP_PAD_FILLS.get(name, 0)
+        out.append(rows)
+    return SamplingRows(*out)
+
+
+def _gather_slot_state(state, slot_ids, batch_idx):
+    """Compaction prologue shared by the decode cores: row views of the
+    per-slot device state (see _decode_plain_core's COMPACTION note).
+    slot_ids=None means rows ARE slots (no gathers)."""
+    full_gstate = state["gstate"]
+    n_slots = full_gstate.shape[0]
+    sids = batch_idx if slot_ids is None else slot_ids
+    sids_r = (batch_idx if slot_ids is None
+              else jnp.clip(slot_ids, 0, n_slots - 1))
+    pm = state.get("prompt_mask")  # None until penalties materialize
+    if pm is not None and slot_ids is not None:
+        pm = pm[sids_r]
+    full_oc = state.get("out_counts")
+    oc0 = (full_oc if slot_ids is None or full_oc is None
+           else full_oc[sids_r])
+    gstate0 = full_gstate if slot_ids is None else full_gstate[sids_r]
+    return sids, sids_r, pm, oc0, gstate0, full_oc, full_gstate
+
+
+def _scatter_slot_state(new_state, slot_ids, sids, oc, gstate,
+                        full_oc, full_gstate):
+    """Compaction epilogue: gathered gstate/out_counts rows back into the
+    full per-slot state (sentinel rows drop)."""
+    if slot_ids is None:
+        new_state["gstate"] = gstate
+        if oc is not None:
+            new_state["out_counts"] = oc
+        return
+    new_state["gstate"] = full_gstate.at[sids].set(gstate, mode="drop")
+    if oc is not None:
+        new_state["out_counts"] = full_oc.at[sids].set(oc, mode="drop")
+
+
 # ---------------------------------------------------------------------------
 # Jitted dispatches (module-level so compiles are shared across servers)
 # ---------------------------------------------------------------------------
@@ -136,29 +210,31 @@ def _split_cache(cache):
     return pools
 
 
-@partial(jax.jit,
-         static_argnames=("cfg", "infer_cfg", "scatter_prompt", "mesh",
-                          "draft_cfg", "use_rows", "use_bias"),
-         donate_argnums=(1,))
-def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
-                   slot_ids, prompt_rows, prompt_lens, rng,
-                   samp_rows, orig_lens, count_mask,
-                   gid=None, gstate0=None, grammar=None,
-                   lora=None, aid=None,
-                   draft_params=None, *,
-                   cfg: ModelConfig, infer_cfg: InferConfig,
-                   scatter_prompt: bool, mesh=None, draft_cfg=None,
-                   use_rows: bool = False, use_bias: bool = False):
-    """One admission chunk for a (padded) G-row group.
+def _prefill_core(params, state, chunk, g_lens, g_tables, sample_at,
+                  slot_ids, prompt_rows, prompt_lens, rng,
+                  samp_rows, orig_lens, count_mask,
+                  gid=None, gstate0=None, grammar=None,
+                  lora=None, aid=None,
+                  draft_params=None, widths=None, scatter_mask=None, *,
+                  cfg: ModelConfig, infer_cfg: InferConfig,
+                  scatter_prompt: bool, mesh=None, draft_cfg=None,
+                  use_rows: bool = False, use_bias: bool = False):
+    """One admission window for a (padded) G-row group — the traced body
+    shared by `_prefill_chunk` (alternating scheduler: uniform chunk
+    width per group) and `_mixed_step` (mixed scheduler: RAGGED per-row
+    `widths`, since the token budget hands every admitting row a
+    different width in the same call, and a per-row `scatter_mask`,
+    since rows at different admission progress share one dispatch).
 
     chunk: (G, Wc) tokens for positions [g_lens, g_lens + Wc) per row —
     rows at different offsets, which is how shared prefixes resume deeper
     and how successive chunks continue. sample_at: in-window index of
     each row's LAST true prompt token (clamped; the caller keeps the
     sample only when it truly falls inside this chunk). On the first
-    chunk (`scatter_prompt`) each row's full prompt is written into its
-    slot's device history for n-gram drafting. Padding rows carry
-    slot_id == max_slots and sentinel tables: every scatter drops.
+    chunk (`scatter_prompt`, further restricted to `scatter_mask` rows
+    when given) each row's full prompt is written into its slot's device
+    history for n-gram drafting. Padding rows carry slot_id == max_slots
+    and sentinel tables: every scatter drops.
 
     Per-request sampling state: `orig_lens` (G,) marks the original
     prompt / generated boundary inside `prompt_rows` (continuations from
@@ -173,7 +249,7 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
     cache = _make_cache(state["pools"], g_lens, g_tables)
     logits, cache = paged_engine.window_forward(
         params, chunk, cfg, cache, logits_at=sample_at, mesh=mesh,
-        lora=lora, aid=aid)
+        lora=lora, aid=aid, widths=widths)
     new_state = dict(state)
     new_state["pools"] = _split_cache(cache)
 
@@ -199,8 +275,10 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
                                 prompt_rows, vsz)
             oc_rows = jnp.zeros((g, vsz), jnp.int32).at[
                 rowi[:, None], oc_cols].add(1, mode="drop")
-            pm = pm.at[slot_ids].set(pm_rows, mode="drop")
-            oc = oc.at[slot_ids].set(oc_rows, mode="drop")
+            sc_ids = (slot_ids if scatter_mask is None
+                      else jnp.where(scatter_mask, slot_ids, pm.shape[0]))
+            pm = pm.at[sc_ids].set(pm_rows, mode="drop")
+            oc = oc.at[sc_ids].set(oc_rows, mode="drop")
     amask = None
     if grammar is not None:
         # constrained rows: allowed first tokens from each row's resume
@@ -254,36 +332,58 @@ def _prefill_chunk(params, state, chunk, g_lens, g_tables, sample_at,
     if scatter_prompt:
         pb = prompt_rows.shape[1]
         cols = jnp.broadcast_to(jnp.arange(pb)[None, :], prompt_rows.shape)
-        cols = jnp.where(cols < prompt_lens[:, None], cols, hist.shape[1])
+        keep = cols < prompt_lens[:, None]
+        if scatter_mask is not None:
+            keep &= scatter_mask[:, None]
+        cols = jnp.where(keep, cols, hist.shape[1])
         hist = hist.at[slot_ids[:, None], cols].set(prompt_rows,
                                                     mode="drop")
     new_state["hist"] = hist
     return new_state, toks, lps
 
 
-@partial(jax.jit,
-         static_argnames=("cfg", "infer_cfg", "n_rounds", "mesh",
-                          "use_rows", "use_bias"),
-         donate_argnums=(1,))
-def _decode_rounds(params, state, lengths, tables, last_token, live,
-                   rng, samp_rows, gid=None, grammar=None,
-                   lora=None, aid=None, *,
-                   cfg: ModelConfig,
-                   infer_cfg: InferConfig, n_rounds: int, mesh=None,
-                   use_rows: bool = False, use_bias: bool = False):
+# Alternating-scheduler admission dispatch: `_prefill_core` at one
+# uniform chunk width per group (widths/scatter_mask default to None —
+# every row full-width, every row scattering on its first chunk).
+_prefill_chunk = partial(jax.jit,
+                         static_argnames=("cfg", "infer_cfg",
+                                          "scatter_prompt", "mesh",
+                                          "draft_cfg", "use_rows",
+                                          "use_bias"),
+                         donate_argnums=(1,))(_prefill_core)
+
+
+def _decode_plain_core(params, state, lengths, tables, last_token, live,
+                       rng, samp_rows, gid=None, grammar=None,
+                       lora=None, aid=None, slot_ids=None, *,
+                       cfg: ModelConfig,
+                       infer_cfg: InferConfig, n_rounds: int, mesh=None,
+                       use_rows: bool = False, use_bias: bool = False):
     """n_rounds plain decode steps (W=1) in one dispatch (lax.scan).
+    Traced body shared by `_decode_rounds` and `_mixed_step`.
 
     `live` slots advance one token per round; the rest are frozen (their
     writes drop through the sentinel tables the caller passes).
     `use_rows` (static) samples through the per-request SamplingRows,
     advancing the generated-token counts for penalties.
 
-    Returns (state', lengths', last', (toks (R, B), lps (R, B),
-    counts (R, B) int32)).
+    COMPACTION (`slot_ids`): rows may be a gathered subset of slots —
+    row i is slot slot_ids[i] (padding rows carry the max_slots
+    sentinel, so their per-slot state scatters drop). The per-slot
+    device state (hist / gstate / penalty counts) stays full-size;
+    lengths / tables / last / samp_rows arrive already gathered. A
+    half-empty batch then dispatches at half the rows — attention
+    gathers and matmuls scale with LIVE slots, not max_slots, which is
+    what keeps decode affordable while admissions hold slots.
+    slot_ids=None means rows ARE slots (the uncompacted layout).
+
+    Returns (state', lengths', last', (toks (R, Bg), lps (R, Bg),
+    counts (R, Bg) int32)) — rows in the caller's gathered order.
     """
     pad = infer_cfg.pad_token_id
     batch_idx = jnp.arange(lengths.shape[0])
-    pm = state.get("prompt_mask")  # None until penalties materialize
+    (sids, sids_r, pm, oc0, gstate0,
+     full_oc, full_gstate) = _gather_slot_state(state, slot_ids, batch_idx)
 
     def body(carry, rng_t):
         lengths, last, hist, pools, oc, gstate = carry
@@ -291,7 +391,7 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
         # (this round writes its kv there); record it in the history so
         # drafting/multi-turn reads see an unbroken token sequence
         cols = jnp.where(live, lengths, hist.shape[1])
-        hist = hist.at[batch_idx, cols].set(last, mode="drop")
+        hist = hist.at[sids, cols].set(last, mode="drop")
         cache = _make_cache(pools, lengths, tables)
         logits, cache = paged_engine.window_forward(
             params, last[:, None], cfg, cache,
@@ -328,29 +428,31 @@ def _decode_rounds(params, state, lengths, tables, last_token, live,
 
     (lengths, last, hist, pools, oc, gstate), out = lax.scan(
         body, (lengths, last_token, state["hist"], state["pools"],
-               state.get("out_counts"), state["gstate"]),
+               oc0, gstate0),
         jax.random.split(rng, n_rounds))
     new_state = dict(state)
     new_state["pools"] = pools
     new_state["hist"] = hist
-    new_state["gstate"] = gstate
-    if oc is not None:
-        new_state["out_counts"] = oc
+    _scatter_slot_state(new_state, slot_ids, sids, oc, gstate,
+                        full_oc, full_gstate)
     return new_state, lengths, last, out
 
 
-@partial(jax.jit,
-         static_argnames=("cfg", "infer_cfg", "n_rounds", "n_drafts",
-                          "mesh", "draft_cfg", "use_rows", "use_bias"),
-         donate_argnums=(1,))
-def _spec_rounds(params, state, lengths, tables, last_token, live,
-                 stop_len, rng, samp_rows, gid=None, grammar=None,
-                 lora=None, aid=None,
-                 draft_params=None, *,
-                 cfg: ModelConfig, infer_cfg: InferConfig, n_rounds: int,
-                 n_drafts: int, mesh=None, draft_cfg=None,
-                 use_rows: bool = False, use_bias: bool = False):
-    """n_rounds speculative rounds in one dispatch.
+_decode_rounds = partial(jax.jit,
+                         static_argnames=("cfg", "infer_cfg", "n_rounds",
+                                          "mesh", "use_rows", "use_bias"),
+                         donate_argnums=(1,))(_decode_plain_core)
+
+
+def _spec_core(params, state, lengths, tables, last_token, live,
+               stop_len, rng, samp_rows, gid=None, grammar=None,
+               lora=None, aid=None,
+               draft_params=None, slot_ids=None, *,
+               cfg: ModelConfig, infer_cfg: InferConfig, n_rounds: int,
+               n_drafts: int, mesh=None, draft_cfg=None,
+               use_rows: bool = False, use_bias: bool = False):
+    """n_rounds speculative rounds in one dispatch. Traced body shared
+    by `_spec_rounds` and `_mixed_step`.
 
     Each round drafts `n_drafts` tokens per slot — from a DRAFT MODEL
     decoding against its own paged cache (draft_params/draft_cfg;
@@ -376,8 +478,12 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
     the same construction, so the accept rule compares the identical
     distributions plain per-token decoding would have sampled from.
 
+    COMPACTION (`slot_ids`): as in `_decode_plain_core` — rows may be a
+    gathered subset of slots; per-slot device state stays full-size and
+    scatters go through slot_ids (sentinel rows drop).
+
     Returns (state', lengths', last',
-    (toks (R, B, G+1), lps (R, B, G+1), counts (R, B))).
+    (toks (R, Bg, G+1), lps (R, Bg, G+1), counts (R, Bg))).
     """
     g = n_drafts
     b = lengths.shape[0]
@@ -385,7 +491,8 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
     batch_idx = jnp.arange(b)
     j = jnp.arange(g + 1)[None, :]
     use_draft = draft_cfg is not None
-    pm = state.get("prompt_mask")  # None until penalties materialize
+    (sids, sids_r, pm, oc0, gstate_init,
+     full_oc, full_gstate) = _gather_slot_state(state, slot_ids, batch_idx)
 
     def body(carry, rng_t):
         lengths, last, hist, pools, dpools, oc, gstate = carry
@@ -396,7 +503,8 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
         # write it into the history BEFORE drafting so bigram lookups
         # spanning the prompt/generated boundary see the true sequence
         cols_last = jnp.where(live, lengths, hist.shape[1])
-        hist = hist.at[batch_idx, cols_last].set(last, mode="drop")
+        hist = hist.at[sids, cols_last].set(last, mode="drop")
+        hist_rows = hist if slot_ids is None else hist[sids_r]
         valid = lengths + 1  # committed tokens = [0, lengths] incl. last
         if use_draft:
             def d_step(dc, inp):
@@ -445,8 +553,8 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
             drafts = jnp.stack(toks_j[:g], axis=1)        # (B, G)
             q_probs = jnp.stack(qps[:g], axis=1)          # (B, G, V)
         else:
-            t_prev2 = hist[batch_idx, jnp.maximum(valid - 2, 0)]
-            drafts = _ngram_drafts(hist, valid, t_prev2, last, g, pad)
+            t_prev2 = hist_rows[batch_idx, jnp.maximum(valid - 2, 0)]
+            drafts = _ngram_drafts(hist_rows, valid, t_prev2, last, g, pad)
         window = jnp.concatenate([last[:, None], drafts], axis=1)
 
         cache = _make_cache(pools, lengths, tables)
@@ -512,7 +620,7 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
         # (position `lengths` holds `last`, written above)
         cols = (lengths + 1)[:, None] + j
         cols = jnp.where(j < count[:, None], cols, hist.shape[1])
-        hist = hist.at[batch_idx[:, None], cols].set(toks, mode="drop")
+        hist = hist.at[sids[:, None], cols].set(toks, mode="drop")
         if use_rows and oc is not None:
             vsz = oc.shape[-1]
             cnt_cols = jnp.where(j < count[:, None], toks, vsz)
@@ -532,18 +640,103 @@ def _spec_rounds(params, state, lengths, tables, last_token, live,
 
     (lengths, last, hist, pools, dpools, oc, gstate), out = lax.scan(
         body, (lengths, last_token, state["hist"], state["pools"],
-               state.get("draft_pools"), state.get("out_counts"),
-               state["gstate"]),
+               state.get("draft_pools"), oc0, gstate_init),
         jax.random.split(rng, n_rounds))
     new_state = dict(state)
     new_state["pools"] = pools
     new_state["hist"] = hist
-    new_state["gstate"] = gstate
-    if oc is not None:
-        new_state["out_counts"] = oc
+    _scatter_slot_state(new_state, slot_ids, sids, oc, gstate,
+                        full_oc, full_gstate)
     if dpools is not None:
         new_state["draft_pools"] = dpools
     return new_state, lengths, last, out
+
+
+_spec_rounds = partial(jax.jit,
+                       static_argnames=("cfg", "infer_cfg", "n_rounds",
+                                        "n_drafts", "mesh", "draft_cfg",
+                                        "use_rows", "use_bias"),
+                       donate_argnums=(1,))(_spec_core)
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "infer_cfg", "n_rounds", "n_drafts",
+                          "scatter_prompt", "mesh",
+                          "use_rows_p", "use_bias_p",
+                          "use_rows_d", "use_bias_d"),
+         donate_argnums=(1,))
+def _mixed_step(params, state,
+                chunk, widths, g_lens, g_tables, sample_at, slot_ids,
+                prompt_rows, prompt_lens, samp_rows_g, orig_lens,
+                count_mask, scatter_mask, gid_g, gstate0_g,
+                lengths, tables, last_token, live, stop_len,
+                samp_rows_b, gid_b, slot_ids_d,
+                rng, grammar=None, lora=None, aid_g=None, aid_b=None, *,
+                cfg: ModelConfig, infer_cfg: InferConfig, n_rounds: int,
+                n_drafts: int, scatter_prompt: bool, mesh=None,
+                use_rows_p: bool = False, use_bias_p: bool = False,
+                use_rows_d: bool = False, use_bias_d: bool = False):
+    """ONE token-budget mixed iteration, ONE jitted program, ONE host
+    sync: the ragged prefill group (every admitting row the budget
+    selected, each at its own width — `_prefill_core` with per-row
+    `widths`/`scatter_mask`) followed by the full multi-round decode
+    dispatch (`_decode_plain_core` / `_spec_core`, n_rounds of W = 1 or
+    drafts + 1).
+
+    This is what "fused" means here and why it is stall-free WITHOUT
+    extra compute: the alternating scheduler pays one host round trip
+    per admission group PLUS one per decode dispatch each iteration, and
+    shrinks decode to `admit_decode_chunk` (default 1) rounds while any
+    admission is in flight; the mixed program keeps decode at its full
+    round count and retires every prefill chunk in the same dispatch, so
+    decode throughput under churn stays at its steady-state slope. Both
+    halves are exactly the alternating dispatches' traced bodies —
+    greedy/seeded outputs are token-for-token identical by construction
+    (tests/test_mixed_scheduler.py).
+
+    Prefill rows and decode rows are DISJOINT slots (a slot is live xor
+    mid-admission), so program order between the halves is irrelevant;
+    slots in neither half ride along fully inert (width 0 and sentinel
+    tables in the prefill group, live=False and sentinel tables in the
+    decode half) — the sentinel-safety invariant for mid-admission rows.
+
+    Returns (state', first-token candidates (G,), their logprobs (G,),
+    lengths', last', (toks (R, B, S), lps (R, B, S), counts (R, B)))
+    with S = n_drafts + 1; n_rounds == 0 (no live decode slot) skips the
+    decode half and returns R = 0 outputs.
+    """
+    rng_p, rng_d = jax.random.split(rng)
+    state, ptoks, plps = _prefill_core(
+        params, state, chunk, g_lens, g_tables, sample_at, slot_ids,
+        prompt_rows, prompt_lens, rng_p, samp_rows_g, orig_lens,
+        count_mask, gid_g, gstate0_g, grammar, lora, aid_g, None,
+        widths, scatter_mask,
+        cfg=cfg, infer_cfg=infer_cfg, scatter_prompt=scatter_prompt,
+        mesh=mesh, draft_cfg=None, use_rows=use_rows_p,
+        use_bias=use_bias_p)
+    s = n_drafts + 1
+    if n_rounds == 0:
+        b = lengths.shape[0]
+        out = (jnp.zeros((0, b, s), jnp.int32),
+               jnp.zeros((0, b, s), jnp.float32),
+               jnp.zeros((0, b), jnp.int32))
+        return state, ptoks, plps, lengths, last_token, out
+    if n_drafts > 0:
+        state, lengths, last, out = _spec_core(
+            params, state, lengths, tables, last_token, live, stop_len,
+            rng_d, samp_rows_b, gid_b, grammar, lora, aid_b, None,
+            slot_ids_d,
+            cfg=cfg, infer_cfg=infer_cfg, n_rounds=n_rounds,
+            n_drafts=n_drafts, mesh=mesh, use_rows=use_rows_d,
+            use_bias=use_bias_d)
+    else:
+        state, lengths, last, (dtoks, dlps, dcnts) = _decode_plain_core(
+            params, state, lengths, tables, last_token, live, rng_d,
+            samp_rows_b, gid_b, grammar, lora, aid_b, slot_ids_d,
+            cfg=cfg, infer_cfg=infer_cfg, n_rounds=n_rounds, mesh=mesh,
+            use_rows=use_rows_d, use_bias=use_bias_d)
+        out = (dtoks[:, :, None], dlps[:, :, None], dcnts)
+    return state, ptoks, plps, lengths, last, out
 
 
 # ---------------------------------------------------------------------------
@@ -564,7 +757,10 @@ class _Slot:
 
 @dataclasses.dataclass
 class _AdmitJob:
-    """An in-flight chunked admission: one bucketed group of slots."""
+    """An in-flight chunked admission: one bucketed group of slots
+    (alternating scheduler) or ONE slot with token-granular progress
+    (mixed scheduler — `done` advances by whatever width the budget
+    granted that iteration, so chunk_w/n_chunks are unused there)."""
 
     slots: list[int]
     chunk_w: int
@@ -578,6 +774,7 @@ class _AdmitJob:
     lps: np.ndarray
     got: np.ndarray                # bool — sample captured yet
     next_chunk: int = 0
+    done: int = 0                  # mixed: remainder tokens prefilled
 
 
 class PagedInferenceServer:
@@ -598,7 +795,9 @@ class PagedInferenceServer:
                  allocation: str = "ondemand",
                  draft_params=None, draft_cfg: ModelConfig | None = None,
                  tokenizer=None, max_pending: int | None = None,
-                 admit_decode_chunk: int | None = 1):
+                 admit_decode_chunk: int | None = 1,
+                 scheduler: str | None = None,
+                 mixed_token_budget: int | None = None):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -801,6 +1000,41 @@ class PagedInferenceServer:
         if admit_decode_chunk is not None and admit_decode_chunk < 1:
             raise ValueError("admit_decode_chunk must be >= 1 or None")
         self.admit_decode_chunk = admit_decode_chunk
+        # Scheduler under admission churn (steady-state decode always
+        # uses the multi-round decode dispatch):
+        #   "mixed" (default) — stall-free token-budget batching: every
+        #     iteration fuses all live decode rows and as many
+        #     prefill-chunk tokens as fit under `mixed_token_budget`
+        #     into ONE ragged window_forward, so decodes never stall
+        #     behind a prefill dispatch and admissions never wait out a
+        #     decode dispatch.
+        #   "alternating" — the r5 behavior (separate prefill-chunk and
+        #     decode dispatches per step); kept as the fallback, and
+        #     selected automatically for draft-model speculation (the
+        #     draft cache's prefill/decode discipline is not fused yet).
+        sched = scheduler if scheduler is not None else infer_cfg.scheduler
+        if sched not in ("mixed", "alternating"):
+            raise ValueError(f"unknown scheduler: {sched!r}")
+        self.scheduler = sched
+        self._mixed_enabled = sched == "mixed" and draft_cfg is None
+        budget = (mixed_token_budget if mixed_token_budget is not None
+                  else infer_cfg.mixed_token_budget)
+        if budget is None or budget <= 0:
+            # auto: effectively work-conserving — a full decode burst
+            # plus a full chunk for every slot fits, so the budget only
+            # bites when set explicitly. Lower it to trade admission
+            # speed for a per-iteration latency (ITL) bound.
+            budget = max_slots * (self.window * self.decode_chunk
+                                  + self.prefill_chunk)
+        if budget < self.window:
+            raise ValueError(
+                f"mixed_token_budget={budget} cannot fit one decode "
+                f"window ({self.window} tokens)")
+        self.mixed_token_budget = int(budget)
+        # dispatch-width buckets for the mixed path (compile-cache bound)
+        self._mixed_buckets = sorted(
+            set(_pow2_buckets(16, self.prefill_chunk))
+            | {_pad_pow2(self.window)})
         self._lock = threading.Lock()
         self._step_lock = threading.Lock()
         self._rng = jax.random.key(seed)
@@ -1136,13 +1370,37 @@ class PagedInferenceServer:
                 staged.append(slot_id)
         if not staged:
             return
+        pad_tok = self.infer_cfg.pad_token_id
+        if self._mixed_enabled:
+            # mixed scheduler: ONE job per slot — progress is
+            # token-granular (`done`), widths are chosen per iteration
+            # by the token budget, so there is no fixed chunk schedule
+            # to share and admissions stay individually preemptible
+            for slot_id in staged:
+                slot = self._slots[slot_id]
+                rem_toks = slot.prompt[slot.shared_len:]
+                rb = self._rem_bucket(len(rem_toks))
+                pb = _bucket(len(slot.prompt), self._admit_buckets)
+                job = _AdmitJob(
+                    slots=[slot_id], chunk_w=rb, n_chunks=1,
+                    rows=np.full((1, rb), pad_tok, np.int32),
+                    rem_lens=np.asarray([len(rem_toks)], np.int32),
+                    base_lens=np.asarray([slot.shared_len], np.int32),
+                    prompt_rows=np.full((1, pb), pad_tok, np.int32),
+                    prompt_lens=np.asarray([len(slot.prompt)], np.int32),
+                    toks=np.zeros((1,), np.int32),
+                    lps=np.zeros((1,), np.float64),
+                    got=np.zeros((1,), bool))
+                job.rows[0, :len(rem_toks)] = rem_toks
+                job.prompt_rows[0, :len(slot.prompt)] = slot.prompt
+                self._jobs.append(job)
+            return
         # group by remainder bucket => uniform chunk schedule per job
         by_bucket: dict[int, list[int]] = {}
         for slot_id in staged:
             slot = self._slots[slot_id]
             rb = self._rem_bucket(len(slot.prompt) - slot.shared_len)
             by_bucket.setdefault(rb, []).append(slot_id)
-        pad_tok = self.infer_cfg.pad_token_id
         for rb, slot_ids in by_bucket.items():
             w = min(rb, self.prefill_chunk)
             n_chunks = -(-rb // w)
@@ -1195,15 +1453,9 @@ class PagedInferenceServer:
         prompt_rows = pad_rows(job.prompt_rows, self.infer_cfg.pad_token_id)
         prompt_lens = pad_rows(job.prompt_lens, 0)
         sl = np.asarray(job.slots)
-        # padding rows get NEUTRAL values (temp 0 = greedy, rep/top_p 1,
-        # bias slots out-of-vocab): their samples are discarded, but
-        # rep=0 would divide to inf/NaN and trip jax_debug_nans even on
-        # discarded rows
-        _fills = {"top_p": 1.0, "rep": 1.0,
-                  "bias_ids": sampling._BIAS_PAD}
-        samp_g = SamplingRows(*[
-            pad_rows(dst[sl], _fills.get(name, 0))
-            for name, dst in zip(SamplingRows._fields, self.samp_rows)])
+        sl_pad = np.zeros((gp,), np.int64)
+        sl_pad[:g] = sl
+        samp_g = _gather_samp_rows(self.samp_rows, sl_pad, g)
         orig_lens = pad_rows(self.orig_len[sl], 0)
         count_mask = pad_rows(in_range, False)
         use_rows = bool(self._needs_rows[sl].any())
@@ -1354,6 +1606,45 @@ class PagedInferenceServer:
             p *= 2
         return p
 
+    def _gather_decode_rows(self):
+        """COMPACTED decode sub-batch: one row per LIVE slot, padded to
+        a power of two (compile cache). Rows carry sentinel slot ids /
+        tables past the live count, so their writes drop everywhere
+        (the cores' slot_ids indirection). Dispatching only live rows
+        is what keeps decode cost proportional to occupancy — a batch
+        half-full of mid-admission slots used to pay full max_slots
+        gathers and matmuls every round.
+
+        A fully-live batch skips the indirection (sl = None, rows ARE
+        slots): steady state keeps the pre-compaction program, so the
+        identity gathers of gstate / penalty rows are never paid there.
+
+        Returns (live_ids, sl, arrays...) for the decode cores."""
+        live_ids = np.flatnonzero(self.active)
+        if len(live_ids) == self.max_slots:
+            return (live_ids, None, self.active.copy(), self.lengths,
+                    self.tables, self.last_token, self.stop_len,
+                    self.samp_rows, self._gid, self._aid)
+        bg = _pad_pow2(max(len(live_ids), 1))
+        nl = len(live_ids)
+        sl = np.full((bg,), self.max_slots, np.int32)
+        sl[:nl] = live_ids
+        slr = np.clip(sl, 0, self.max_slots - 1)
+        live_g = np.zeros((bg,), bool)
+        live_g[:nl] = True
+        lengths = self.lengths[slr].copy()
+        tables = self.tables[slr].copy()
+        tables[nl:] = self.allocator.num_pages
+        last = self.last_token[slr].copy()
+        stop = self.stop_len[slr].copy()
+        samp = _gather_samp_rows(self.samp_rows, slr, nl)
+        gid = self._gid[slr].copy()
+        gid[nl:] = 0
+        aid = self._aid[slr].copy()
+        aid[nl:] = 0
+        return live_ids, sl, live_g, lengths, tables, last, stop, \
+            samp, gid, aid
+
     def _decode_dispatch(self) -> None:
         n = self._chunk_rounds()
         if self.allocation == "ondemand":
@@ -1364,29 +1655,27 @@ class PagedInferenceServer:
             while n > n_eff:  # keep round counts powers of two (compile
                 n //= 2      # cache) while honouring chain coverage
             n = max(1, n)
-        live = self.active.copy()
-        # non-live slots (mid-admission or empty) must not write through
-        # their real tables — the batch-wide window would clobber pages
-        # their prefill chunks are filling
-        masked_tables = np.where(live[:, None], self.tables,
-                                 self.allocator.num_pages)
-        args = (jnp.asarray(self.lengths), jnp.asarray(masked_tables),
-                jnp.asarray(self.last_token), jnp.asarray(live))
-        samp = jax.tree.map(jnp.asarray, self.samp_rows)
+        (live_ids, sl, live_g, lengths, tables, last_np, stop, samp_g,
+         gid_np, aid_np) = self._gather_decode_rows()
+        args = (jnp.asarray(lengths), jnp.asarray(tables),
+                jnp.asarray(last_np), jnp.asarray(live_g))
+        samp = jax.tree.map(jnp.asarray, samp_g)
+        live = self.active
         use_rows = bool((self._needs_rows & live).any())
         use_bias = bool((self._has_bias & live).any())
         use_grammar = bool(((self._gid > 0) & live).any())
-        gid = jnp.asarray(self._gid)
+        gid = jnp.asarray(gid_np)
         grammar = self._grammar_dev if use_grammar else None
         use_lora = bool(((self._aid > 0) & live).any())
         lora = self.adapters.device_args() if use_lora else None
-        aid = jnp.asarray(self._aid)
+        aid = jnp.asarray(aid_np)
+        sl_dev = None if sl is None else jnp.asarray(sl)
         if self.spec_drafts > 0:
             self.state, lens, last, (toks, lps, counts) = _spec_rounds(
                 self.params, self.state, *args,
-                jnp.asarray(self.stop_len), self._next_rng(), samp,
+                jnp.asarray(stop), self._next_rng(), samp,
                 gid, grammar, lora, aid,
-                self.draft_params,
+                self.draft_params, sl_dev,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
                 n_drafts=self.spec_drafts, mesh=self.mesh,
                 draft_cfg=self.draft_cfg, use_rows=use_rows,
@@ -1396,29 +1685,237 @@ class PagedInferenceServer:
         else:
             self.state, lens, last, (toks, lps, counts) = _decode_rounds(
                 self.params, self.state, *args, self._next_rng(), samp,
-                gid, grammar, lora, aid,
+                gid, grammar, lora, aid, sl_dev,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
                 mesh=self.mesh, use_rows=use_rows, use_bias=use_bias)
             toks, lps, counts, lens, last = jax.device_get(
                 (toks, lps, counts, lens, last))
             toks, lps = toks[:, :, None], lps[:, :, None]
+        self._commit_decode_rows(live_ids, toks, lps, counts, lens, last)
 
-        self.lengths = np.asarray(lens).copy()
-        self.last_token = np.asarray(last).copy()
+    def _commit_decode_rows(self, live_ids, toks, lps, counts, lens,
+                            last) -> None:
+        """Scatter a compacted decode dispatch's results back to slots
+        and emit (shared by _decode_dispatch and _mixed_dispatch)."""
+        nl = len(live_ids)
+        lens = np.asarray(lens)
+        last = np.asarray(last)
         counts = np.asarray(counts)
-        n_live = int(live.sum())
-        self.decode_rounds += int(counts.shape[0]) * n_live
+        self.lengths[live_ids] = lens[:nl]
+        self.last_token[live_ids] = last[:nl]
+        self.decode_rounds += int(counts.shape[0]) * nl
         self.decode_tokens_committed += int(counts.sum())
         for r in range(toks.shape[0]):
-            for sid in range(self.max_slots):
+            for i, sid in enumerate(live_ids):
                 slot = self._slots[sid]
                 if slot is None or not self.active[sid]:
                     continue
-                for t in range(int(counts[r, sid])):
-                    if self._emit(slot.req, int(toks[r, sid, t]),
-                                  float(lps[r, sid, t])):
+                for t in range(int(counts[r, i])):
+                    if self._emit(slot.req, int(toks[r, i, t]),
+                                  float(lps[r, i, t])):
                         self._finish(sid)
                         break
+
+    # -- mixed (stall-free) scheduling --------------------------------------
+
+    def _mixed_rounds(self, n_live: int, prefill_demand: int) -> int:
+        """Decode rounds for a mixed iteration: the full steady-state
+        count (`_chunk_rounds` WITHOUT the admit shrink — not stalling
+        decode is the point), then squeezed to leave the budget at least
+        one minimal prefill chunk when admissions are waiting, floored
+        at one round and kept a power of two (compile cache)."""
+        rem = [s.req.max_new_tokens - len(s.req.tokens)
+               for i, s in enumerate(self._slots)
+               if s is not None and self.active[i]]
+        if not rem or not n_live:
+            return 0
+        n = max(1, min(self.decode_chunk, -(-min(rem) // self.window)))
+        if prefill_demand > 0:
+            fit = (self.mixed_token_budget - self._rem_buckets[0]) \
+                // (n_live * self.window)
+            n = min(n, max(fit, 1))
+        p = 1
+        while p * 2 <= n:
+            p *= 2
+        return p
+
+    def _mixed_dispatch(self) -> None:
+        """One token-budget iteration: the multi-round decode dispatch
+        for every live slot plus as many prefill-chunk tokens as fit
+        under `mixed_token_budget`, fused into ONE jitted program with
+        ONE host sync (`_mixed_step`).
+
+        Budget split: decode rows are admitted first (live slots advance
+        their full round count every iteration — the stall-free
+        property); the remainder goes to in-flight admissions FIFO, each
+        grabbing up to `prefill_chunk` tokens AT ITS OWN WIDTH — the
+        ragged prefill group replaces the alternating scheduler's
+        per-bucket admission dispatches. When decode alone saturates the
+        budget, the OLDEST admission still gets one minimal chunk so
+        TTFT stays bounded (the budget is a target, not a hard cap).
+        Admitting slots not selected this iteration ride along inert:
+        width 0 and sentinel tables, so nothing they own can be
+        written."""
+        b = self.max_slots
+        demand = sum(int(j.rem_lens[0]) - j.done for j in self._jobs)
+        n_live = int(self.active.sum())
+        n_rounds = self._mixed_rounds(n_live, demand)
+        if self.allocation == "ondemand" and n_rounds > 0:
+            n_eff = self._extend_chains(n_rounds)
+            if n_eff <= 0 or not self.active.any():
+                n_rounds = 0  # transient page famine: prefill-only
+            else:
+                while n_rounds > n_eff:
+                    n_rounds //= 2
+                n_rounds = max(1, n_rounds)
+        live = self.active if n_rounds > 0 else np.zeros((b,), bool)
+        n_live = int(live.sum())
+
+        sel: list[tuple[_AdmitJob, int]] = []
+        left = self.mixed_token_budget - n_live * self.window * n_rounds
+        for job in self._jobs:
+            if left <= 0:
+                break
+            rem_left = int(job.rem_lens[0]) - job.done
+            take = min(rem_left, left, self.prefill_chunk)
+            if take <= 0:
+                continue
+            sel.append((job, take))
+            left -= take
+        if self._jobs and not sel:
+            job = self._jobs[0]
+            take = min(int(job.rem_lens[0]) - job.done,
+                       self._rem_buckets[0])
+            sel = [(job, take)]
+        if not sel and not n_rounds:
+            return
+
+        # -- ragged prefill group (one row per selected admission) ----------
+        pad_tok = self.infer_cfg.pad_token_id
+        g = len(sel)
+        gp = _pad_pow2(max(g, 1))
+        w = _bucket(max([t for _, t in sel] + [1]), self._mixed_buckets)
+        chunk = np.full((gp, w), pad_tok, np.int32)
+        widths = np.zeros((gp,), np.int32)
+        g_lens = np.zeros((gp,), np.int32)
+        g_tables = np.full((gp, self.max_pages_per_slot),
+                           self.allocator.num_pages, np.int32)
+        sample_at = np.zeros((gp,), np.int32)
+        slot_ids = np.full((gp,), self.max_slots, np.int32)
+        countm = np.zeros((gp,), bool)
+        scatm = np.zeros((gp,), bool)
+        scat_plens = []
+        for i, (job, take) in enumerate(sel):
+            sid = job.slots[0]
+            d0 = job.done
+            rl = int(job.rem_lens[0])
+            chunk[i, :take] = job.rows[0, d0:d0 + take]
+            widths[i] = take
+            g_lens[i] = int(job.base_lens[0]) + d0
+            g_tables[i] = self.tables[sid]
+            sample_at[i] = min(max(rl - 1 - d0, 0), take - 1)
+            slot_ids[i] = sid
+            countm[i] = d0 <= rl - 1 < d0 + take
+            scatm[i] = d0 == 0
+            if d0 == 0:
+                scat_plens.append(int(job.prompt_lens[0]))
+        pb = (_bucket(max(scat_plens), self._admit_buckets)
+              if scat_plens else self._admit_buckets[0])
+        prompt_rows = np.full((gp, pb), pad_tok, np.int32)
+        prompt_lens = np.zeros((gp,), np.int32)
+        orig_lens = np.zeros((gp,), np.int32)
+        for i, (job, take) in enumerate(sel):
+            sid = job.slots[0]
+            pl = int(job.prompt_lens[0])
+            prompt_lens[i] = pl
+            orig_lens[i] = self.orig_len[sid]
+            if job.done == 0:
+                prompt_rows[i, :pl] = job.prompt_rows[0, :pl]
+        sl = slot_ids.copy()
+        sl_real = np.clip(sl, 0, self.max_slots - 1)
+        samp_g = _gather_samp_rows(self.samp_rows, sl_real, g)
+        gid_g = self._gid[sl_real].copy()
+        gid_g[g:] = 0
+        gst0_g = self._gstate0[sl_real].copy()
+        gst0_g[g:] = 0
+        aid_g = self._aid[sl_real].copy()
+        aid_g[g:] = 0
+        sel_mask = np.zeros((b,), bool)
+        sel_mask[[job.slots[0] for job, _ in sel]] = True
+        use_rows_p = bool((self._needs_rows & sel_mask).any())
+        use_bias_p = bool((self._has_bias & sel_mask).any())
+
+        # -- decode half (compacted: one row per live slot) -----------------
+        (live_ids, sl_d, live_g, d_lens, d_tables, d_last, d_stop,
+         samp_d, gid_d, aid_d) = self._gather_decode_rows()
+        if n_rounds == 0:
+            live_g = np.zeros_like(live_g)
+        use_rows_d = bool((self._needs_rows & live).any())
+        use_bias_d = bool((self._has_bias & live).any())
+        use_grammar = bool(((self._gid > 0) & (live | sel_mask)).any())
+        use_lora = bool(((self._aid > 0) & (live | sel_mask)).any())
+
+        self.state, ptoks, plps, lens, last, (toks, lps, counts) = \
+            _mixed_step(
+                self.params, self.state, jnp.asarray(chunk),
+                jnp.asarray(widths), jnp.asarray(g_lens),
+                jnp.asarray(g_tables), jnp.asarray(sample_at),
+                jnp.asarray(slot_ids), jnp.asarray(prompt_rows),
+                jnp.asarray(prompt_lens),
+                jax.tree.map(jnp.asarray, samp_g),
+                jnp.asarray(orig_lens), jnp.asarray(countm),
+                jnp.asarray(scatm), jnp.asarray(gid_g),
+                jnp.asarray(gst0_g),
+                jnp.asarray(d_lens), jnp.asarray(d_tables),
+                jnp.asarray(d_last), jnp.asarray(live_g),
+                jnp.asarray(d_stop),
+                jax.tree.map(jnp.asarray, samp_d),
+                jnp.asarray(gid_d),
+                None if sl_d is None else jnp.asarray(sl_d),
+                self._next_rng(),
+                self._grammar_dev if use_grammar else None,
+                self.adapters.device_args() if use_lora else None,
+                jnp.asarray(aid_g), jnp.asarray(aid_d),
+                cfg=self.cfg, infer_cfg=self.infer_cfg,
+                n_rounds=n_rounds, n_drafts=self.spec_drafts,
+                scatter_prompt=bool(scatm.any()), mesh=self.mesh,
+                use_rows_p=use_rows_p, use_bias_p=use_bias_p,
+                use_rows_d=use_rows_d, use_bias_d=use_bias_d)
+        ptoks, plps, toks, lps, counts, lens, last = jax.device_get(
+            (ptoks, plps, toks, lps, counts, lens, last))
+
+        if n_rounds > 0:
+            self._commit_decode_rows(live_ids, np.asarray(toks),
+                                     np.asarray(lps), counts, lens, last)
+
+        # prefill progress: capture first tokens, activate completed
+        # admissions (mirrors _run_one_chunk's completion block)
+        ptoks, plps = np.asarray(ptoks), np.asarray(plps)
+        for i, (job, take) in enumerate(sel):
+            sid = job.slots[0]
+            rl = int(job.rem_lens[0])
+            d0 = job.done
+            if d0 <= rl - 1 < d0 + take:
+                job.toks[0] = ptoks[i]
+                job.lps[0] = plps[i]
+                job.got[0] = True
+            job.done = d0 + take
+            if job.done < rl:
+                continue
+            slot = self._slots[sid]
+            assert bool(job.got[0]), "first-token sample never captured"
+            self.lengths[sid] = len(slot.prompt)
+            self.last_token[sid] = int(job.toks[0])
+            if slot.req._cancel.is_set():
+                slot = self._release_slot(sid, self._committed(sid))
+                slot.req.finish_reason = "cancelled"
+                slot.req._done.set()
+            else:
+                self.active[sid] = True
+                if self._emit(slot.req, int(job.toks[0]),
+                              float(job.lps[0])):
+                    self._finish(sid)
+            self._jobs.remove(job)
 
     # -- scheduler ----------------------------------------------------------
 
@@ -1437,16 +1934,21 @@ class PagedInferenceServer:
 
     def step(self) -> int:
         """One scheduler iteration: reap cancellations, start
-        admissions, run ONE prefill chunk per in-flight admission job
-        (chunked prefill interleaving), then one decode dispatch.
-        Thread-safe."""
+        admissions, then dispatch. With the mixed scheduler and any
+        admission in flight, prefill chunks and decode rows fuse into
+        ONE token-budget dispatch (stall-free); otherwise (steady state,
+        or the alternating scheduler) prefill chunks and a multi-round
+        decode dispatch run separately. Thread-safe."""
         with self._step_lock:
             self._sweep_cancelled()
             self._start_admissions()
-            for job in list(self._jobs):
-                self._run_one_chunk(job)
-            if self.active.any():
-                self._decode_dispatch()
+            if self._mixed_enabled and self._jobs:
+                self._mixed_dispatch()
+            else:
+                for job in list(self._jobs):
+                    self._run_one_chunk(job)
+                if self.active.any():
+                    self._decode_dispatch()
             return self.num_active
 
     def run_until_idle(self) -> None:
@@ -1493,13 +1995,19 @@ class PagedInferenceServer:
         self._thread.start()
         return self
 
-    def drain(self, timeout: float | None = None) -> bool:
+    def drain(self, timeout: float | None = None, *,
+              _resume_on_timeout: bool = True) -> bool:
         """Graceful drain: refuse new submissions, let everything
-        already accepted run to completion. Returns True once idle. On
-        timeout returns False and RESUMES accepting (the in-flight work
-        keeps running; call stop() to actually shut down — it fails
-        whatever is still live so no waiter hangs). Safe with or
-        without the background scheduler thread."""
+        already accepted run to completion. Returns True once idle —
+        and STAYS draining (quiesced): call resume() to accept again,
+        or stop() to shut down. On timeout returns False and RESUMES
+        accepting (the in-flight work keeps running; call stop() to
+        actually shut down — it fails whatever is still live so no
+        waiter hangs). Safe with or without the background scheduler
+        thread. `_resume_on_timeout=False` is stop(drain=True)'s
+        internal latch: a timed-out drain there must NOT reopen
+        submission in the window before _stop is set, or a request
+        could be accepted just to be failed."""
         with self._lock:
             self._draining = True
         deadline = (None if timeout is None
@@ -1510,8 +2018,9 @@ class PagedInferenceServer:
 
         while busy():
             if deadline is not None and time.perf_counter() > deadline:
-                with self._lock:
-                    self._draining = False
+                if _resume_on_timeout:
+                    with self._lock:
+                        self._draining = False
                 return False
             if self._thread is None:
                 self.step()
@@ -1519,10 +2028,19 @@ class PagedInferenceServer:
                 time.sleep(0.002)
         return True
 
+    def resume(self) -> None:
+        """Clear a successful drain's quiesce: accept submissions again
+        (no thread restart needed — the scheduler never stopped)."""
+        with self._lock:
+            self._draining = False
+
     def stop(self, drain: bool = False,
              timeout: float | None = None) -> None:
         if drain and not self._stop.is_set():
-            self.drain(timeout)
+            # keep _draining latched across a timed-out drain: between
+            # drain() returning False and _stop.set() below, a submit()
+            # must be rejected, not accepted-then-failed by _fail_all
+            self.drain(timeout, _resume_on_timeout=False)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)

@@ -42,6 +42,12 @@ class ReplicatedRouter:
         self.replicas = list(replicas)
         self._rr = itertools.count()
         self._lock = threading.Lock()
+        # submits picked but not yet visible in their replica's pending
+        # queue: _pick() counts them so concurrent submitters see fresh
+        # load instead of racing into the same replica (the lock is NOT
+        # held across the replica's submit() — that can block on model
+        # work — so the counter is what bridges the window)
+        self._inflight = [0] * len(self.replicas)
 
     @classmethod
     def over_devices(cls, params, cfg, infer_cfg, *, devices=None,
@@ -61,17 +67,27 @@ class ReplicatedRouter:
 
     # -- placement ----------------------------------------------------------
 
-    def _pick(self) -> int:
-        loads = [r.num_active + r.num_pending for r in self.replicas]
+    def _pick(self, *, count_inflight: bool = False) -> int:
+        loads = [r.num_active + r.num_pending + inf
+                 for r, inf in zip(self.replicas, self._inflight)]
         k = next(self._rr) % len(self.replicas)
         # least loaded; ties resolve round-robin from k
-        return min(range(len(loads)),
-                   key=lambda i: (loads[i], (i - k) % len(loads)))
+        i = min(range(len(loads)),
+                key=lambda i: (loads[i], (i - k) % len(loads)))
+        if count_inflight:
+            self._inflight[i] += 1
+        return i
 
     def submit(self, prompt, **kw):
         with self._lock:
-            i = self._pick()
-        return self.replicas[i].submit(prompt, **kw)
+            i = self._pick(count_inflight=True)
+        try:
+            return self.replicas[i].submit(prompt, **kw)
+        finally:
+            # the request is now in the replica's pending queue (or was
+            # rejected) — either way its load is visible/settled again
+            with self._lock:
+                self._inflight[i] -= 1
 
     def generate(self, prompts, *, max_new_tokens=None):
         reqs = [self.submit(p, max_new_tokens=max_new_tokens)

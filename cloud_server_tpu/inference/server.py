@@ -975,21 +975,27 @@ class InferenceServer:
             if busy == 0 and self.num_pending == 0:
                 self._stop.wait(idle_sleep_s)
 
-    def drain(self, timeout: float | None = None) -> bool:
+    def drain(self, timeout: float | None = None, *,
+              _resume_on_timeout: bool = True) -> bool:
         """Graceful drain: refuse new submissions, let everything
-        already accepted finish. Returns True once idle. On timeout
-        returns False and RESUMES accepting (the in-flight work keeps
-        running; call stop() to actually shut down — it fails whatever
-        is still live so no waiter hangs). Same contract as the paged
-        server's."""
+        already accepted finish. Returns True once idle — and STAYS
+        draining (quiesced): call resume() to accept again, or stop()
+        to shut down. On timeout returns False and RESUMES accepting
+        (the in-flight work keeps running; call stop() to actually shut
+        down — it fails whatever is still live so no waiter hangs).
+        Same contract as the paged server's, including the
+        `_resume_on_timeout=False` internal latch stop(drain=True) uses
+        so a timed-out drain cannot reopen submission in the window
+        before _stop is set."""
         with self._lock:
             self._draining = True
         deadline = (None if timeout is None
                     else time.perf_counter() + timeout)
         while self.num_pending or self.num_active:
             if deadline is not None and time.perf_counter() > deadline:
-                with self._lock:
-                    self._draining = False
+                if _resume_on_timeout:
+                    with self._lock:
+                        self._draining = False
                 return False
             if self._thread is None:
                 self.step()
@@ -997,10 +1003,18 @@ class InferenceServer:
                 time.sleep(0.002)
         return True
 
+    def resume(self) -> None:
+        """Clear a successful drain's quiesce: accept submissions again
+        (no thread restart needed — the scheduler never stopped)."""
+        with self._lock:
+            self._draining = False
+
     def stop(self, drain: bool = False,
              timeout: float | None = None) -> None:
         if drain and not self._stop.is_set():
-            self.drain(timeout)
+            # keep _draining latched across a timed-out drain (see the
+            # paged server's stop() for why)
+            self.drain(timeout, _resume_on_timeout=False)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)

@@ -50,6 +50,17 @@ import numpy as _np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across versions;
+# resolve whichever this jax ships so the kernel imports everywhere
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+if _CompilerParams is None:  # diagnose clearly at first use, not import
+    def _CompilerParams(*_a, **_k):
+        raise ImportError(
+            "this jax exposes neither pallas.tpu.CompilerParams nor "
+            "TPUCompilerParams; the fused-CE pallas kernels need one — "
+            "use ce_impl='dense' or change jax versions")
+
 NEG_INF = -1e30
 
 
@@ -194,7 +205,7 @@ def _fwd(x, head, targets, interpret):
             pltpu.VMEM((tn, 1), jnp.float32),
             pltpu.VMEM((tn, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(x, head, t2)
@@ -215,7 +226,7 @@ def _bwd(interpret, res, cts):
     # big vocab tiles keep the MXU busy and the grid short
     tv = _pick_tile(v, 3200, 128)
     nr, nv = n // tn, v // tv
-    bwd_params = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+    bwd_params = _CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
     g = d_logz.astype(jnp.float32)[:, None]
     h = d_tl.astype(jnp.float32)[:, None]
     row_specs = [

@@ -8,6 +8,19 @@ different window widths W:
   * speculative verification:  W = draft length + 1
   * prefix-cache continuation: W = remainder bucket
   * chunked prefill:           W = chunk
+  * mixed batch (stall-free):  W = max over rows, RAGGED per-row widths
+
+The last row is the token-budget mixed scheduler's dispatch: decode rows
+(width 1, or drafts+1 under speculation) and prefill-chunk rows (width =
+chunk) share ONE call. Per-row `widths` make the window ragged: row b's
+valid queries are window indices [0, widths[b]) at absolute positions
+[lengths[b] - widths[b], lengths[b]) — i.e. `lengths` still counts kv
+INCLUDING the row's (own-width) window, and the causal mask anchors each
+row at `lengths[b] - widths[b]` instead of the uniform `lengths[b] - W`.
+Rows past their width produce garbage (masked by the caller), exactly
+like inactive slots. The XLA fallback implements the identical ragged
+rule, so both bucket shapes (decode window and prefill chunk) ride one
+dispatch on every backend.
 
 The KV cache is PAGED: a global pool of fixed-size pages plus a per-slot
 int32 page table, so slot memory scales with actual context (not
@@ -84,6 +97,7 @@ def _paged_attention_kernel(
     # scalar prefetch
     lens_ref,          # (B,) i32 — kv length per slot INCLUDING the window
     tables_ref,        # (B, max_pages) i32
+    widths_ref,        # (B,) i32 — per-row valid window width (<= W)
     layer_ref,         # (1,) i32 — which pool layer this call attends to
     # inputs
     q_ref,             # (B, KH, WG, Dh) VMEM
@@ -168,9 +182,10 @@ def _paged_attention_kernel(
     buf_idx = jnp.int32(0)
     for b in range(batch):  # static unroll over slots
         kv_len = lens_ref[b]
-        # window row wi sits at absolute position kv_len - W + wi; rows of
+        # window row wi sits at absolute position kv_len - widths[b] + wi
+        # (ragged anchor: widths[b] == W for uniform windows); rows of
         # the folded (W*G, ...) layout map to window position row // G
-        row_pos = (kv_len - w) + lax.broadcasted_iota(
+        row_pos = (kv_len - widths_ref[b]) + lax.broadcasted_iota(
             jnp.int32, (wg, blk), 0) // g
 
         def body(i, carry, b=b, kv_len=kv_len, row_pos=row_pos):
@@ -260,14 +275,19 @@ _NARROW_MAX_B = 16
 def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
                     scale=None, pages_per_block: int = 4,
                     interpret: bool | None = None,
-                    k_scale_pool=None, v_scale_pool=None):
-    """Uniform-window attention against a paged KV cache.
+                    k_scale_pool=None, v_scale_pool=None, widths=None):
+    """Uniform- or ragged-window attention against a paged KV cache.
 
     Args:
       q: (B, W, H, Dh) — W new positions per slot; slot b's window
         occupies absolute positions [lengths[b] - W, lengths[b]). Its kv
         entries must already be written to the pool (write-then-attend,
         same contract as engine.verify_step).
+      widths: optional (B,) int32 per-row valid window widths (<= W) for
+        RAGGED mixed batches: row b's window then occupies
+        [lengths[b] - widths[b], lengths[b]) and query rows at window
+        index >= widths[b] are garbage (mask downstream). None = uniform
+        width W for every row.
       k_pool, v_pool: (L, num_pages, KH, Dh, page_size) TRANSPOSED page
         pools (cfg.dtype, or int8 with the scale pools given). The layer
         dim stays on the operand — `layer` selects inside the kernel, so
@@ -300,6 +320,8 @@ def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
             "manual-DMA slices tile the minor dim by 128)")
     int8_kv = k_scale_pool is not None
     npages = max(1, min(pages_per_block, tables.shape[1]))
+    if widths is None:
+        widths = jnp.full((b,), w, jnp.int32)
 
     # fold (W, G) query rows per kv head: (B, W, KH, G, Dh) -> (B, KH, WG, Dh)
     qg = q.reshape(b, w, kh, g, d).transpose(0, 2, 1, 3, 4).reshape(
@@ -307,7 +329,7 @@ def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
 
     if w > _NARROW_MAX_W or b > _NARROW_MAX_B:
         out = _paged_attention_wide(
-            qg, k_pool, v_pool, lengths, tables, layer, scale=scale,
+            qg, k_pool, v_pool, lengths, tables, widths, layer, scale=scale,
             npages=npages, interpret=interpret,
             k_scale_pool=k_scale_pool, v_scale_pool=v_scale_pool, w=w, g=g)
         return out.reshape(b, kh, w, g, d).transpose(0, 2, 1, 3, 4).reshape(
@@ -337,7 +359,7 @@ def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
     scratch += [pltpu.SemaphoreType.DMA((2, npages))]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(1,),
         in_specs=in_specs,
         out_specs=_full((b, kh, w * g, d)),
@@ -352,14 +374,15 @@ def paged_attention(q, k_pool, v_pool, lengths, tables, layer=0, *,
         out_shape=jax.ShapeDtypeStruct((b, kh, w * g, d), q.dtype),
         interpret=interpret,
     )(lengths.astype(jnp.int32), tables.astype(jnp.int32),
+      widths.astype(jnp.int32),
       jnp.asarray(layer, jnp.int32).reshape(1), *inputs)
     # (B, KH, WG, Dh) -> (B, W, H, Dh)
     return out.reshape(b, kh, w, g, d).transpose(0, 2, 1, 3, 4).reshape(
         b, w, h, d)
 
 
-def _paged_attention_wide(qg, k_pool, v_pool, lengths, tables, layer, *,
-                          scale, npages, interpret, k_scale_pool,
+def _paged_attention_wide(qg, k_pool, v_pool, lengths, tables, widths,
+                          layer, *, scale, npages, interpret, k_scale_pool,
                           v_scale_pool, w, g):
     """Grid-over-(slot, kv head) dispatch for wide windows / big batches.
     qg: (B, KH, WG, Dh) folded queries; returns the same layout."""
@@ -389,7 +412,7 @@ def _paged_attention_wide(qg, k_pool, v_pool, lengths, tables, layer, *,
     scratch += [pltpu.SemaphoreType.DMA((2, npages))]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(b, kh),
         in_specs=in_specs,
         out_specs=cell,
@@ -404,6 +427,7 @@ def _paged_attention_wide(qg, k_pool, v_pool, lengths, tables, layer, *,
         out_shape=jax.ShapeDtypeStruct((b, kh, wg, d), qg.dtype),
         interpret=interpret,
     )(lengths.astype(jnp.int32), tables.astype(jnp.int32),
+      widths.astype(jnp.int32),
       jnp.asarray(layer, jnp.int32).reshape(1), *inputs)
 
 
@@ -411,6 +435,7 @@ def _paged_attention_wide_kernel(
     # scalar prefetch
     lens_ref,          # (B,) i32 — kv length per slot INCLUDING the window
     tables_ref,        # (B, max_pages) i32
+    widths_ref,        # (B,) i32 — per-row valid window width (<= W)
     layer_ref,         # (1,) i32
     # inputs
     q_ref,             # (1, 1, WG, Dh) VMEM — this (slot, kv head)'s rows
@@ -494,7 +519,7 @@ def _paged_attention_wide_kernel(
             c.wait()
 
     start_fetch(0, 0)
-    row_pos = (kv_len - w) + lax.broadcasted_iota(
+    row_pos = (kv_len - widths_ref[b]) + lax.broadcasted_iota(
         jnp.int32, (wg, blk), 0) // g
     qh = q_ref[0, 0].astype(dot_dtype)  # (WG, Dh)
 
@@ -546,7 +571,7 @@ def paged_attention_tp(q, k_pool, v_pool, lengths, tables, layer=0, *,
                        mesh, axis_name: str = "tp", scale=None,
                        pages_per_block: int = 4,
                        interpret: bool | None = None,
-                       k_scale_pool=None, v_scale_pool=None):
+                       k_scale_pool=None, v_scale_pool=None, widths=None):
     """`paged_attention` under tensor parallelism: kv heads shard over
     `axis_name`, each device runs the kernel on its local heads.
 
@@ -577,21 +602,23 @@ def paged_attention_tp(q, k_pool, v_pool, lengths, tables, layer=0, *,
         raise ValueError(
             f"tp={ntp} must divide num_kv_heads={kh} (and heads={h}) to "
             "shard the paged-attention kernel")
+    if widths is None:
+        widths = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
     head_spec = P(None, None, axis_name, None)
     pool_spec = P(None, None, axis_name, None, None)
     rep = P()
-    in_specs = [head_spec, pool_spec, pool_spec, rep, rep]
-    args = [q, k_pool, v_pool, lengths, tables]
+    in_specs = [head_spec, pool_spec, pool_spec, rep, rep, rep]
+    args = [q, k_pool, v_pool, lengths, tables, widths]
     if k_scale_pool is not None:
         in_specs += [P(None, None, axis_name, None)] * 2
         args += [k_scale_pool, v_scale_pool]
 
-    def local(q_l, k_l, v_l, lens, tabs, *scales):
+    def local(q_l, k_l, v_l, lens, tabs, wid, *scales):
         return paged_attention(
             q_l, k_l, v_l, lens, tabs, layer, scale=scale,
             pages_per_block=pages_per_block, interpret=interpret,
             k_scale_pool=scales[0] if scales else None,
-            v_scale_pool=scales[1] if scales else None)
+            v_scale_pool=scales[1] if scales else None, widths=wid)
 
     return shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
                      out_specs=head_spec, **no_check)(*args)
@@ -622,12 +649,16 @@ def gather_scale_pages(scale_pool, tables, layer=0):
 
 
 def paged_attention_xla(q, k_pool, v_pool, lengths, tables, layer=0, *,
-                        scale=None, k_scale_pool=None, v_scale_pool=None):
+                        scale=None, k_scale_pool=None, v_scale_pool=None,
+                        widths=None):
     """Dense-XLA equivalent of `paged_attention` (gather + masked attention).
 
     The test oracle, and the serving fallback on non-TPU backends. The
     gather materialises each slot's full padded cache view per call, so on
-    TPU the pallas kernel is strictly preferred.
+    TPU the pallas kernel is strictly preferred. `widths` follows the
+    kernel's ragged rule in lockstep: row b's queries anchor at
+    lengths[b] - widths[b] (rows past their width are garbage, masked by
+    the caller).
     """
     from cloud_server_tpu.ops.attention import causal_attention
 
@@ -638,6 +669,8 @@ def paged_attention_xla(q, k_pool, v_pool, lengths, tables, layer=0, *,
     if k_scale_pool is not None:
         scales = dict(k_scale=gather_scale_pages(k_scale_pool, tables, layer),
                       v_scale=gather_scale_pages(v_scale_pool, tables, layer))
-    pos = lengths[:, None] - w + jnp.arange(w)[None, :]
+    anchor = lengths - (jnp.full((b,), w, jnp.int32) if widths is None
+                        else widths)
+    pos = anchor[:, None] + jnp.arange(w)[None, :]
     return causal_attention(q, k, v, scale=scale, q_positions=pos,
                             kv_length=lengths, **scales)

@@ -194,7 +194,10 @@ def _abstract_sharded_params(model_cfg: ModelConfig, mesh,
 
 
 def _latest_step(directory: str) -> int:
-    steps = ocp.utils.checkpoint_steps(directory)
+    try:
+        steps = ocp.utils.checkpoint_steps(directory)
+    except ValueError:  # older orbax raises instead of returning [] for
+        steps = []      # a directory that does not exist
     if not steps:
         raise FileNotFoundError(f"no checkpoint found under {directory}")
     return max(steps)
@@ -212,16 +215,27 @@ def restore_params(checkpoint_dir: str | os.PathLike, model_cfg: ModelConfig,
     directory = os.path.abspath(os.fspath(checkpoint_dir))
     if step is None:
         step = _latest_step(directory)
+    path = os.path.join(directory, str(step), "default")
     target = {"params": _abstract_sharded_params(model_cfg, mesh, rules,
                                                  loss_fn_module)}
-    restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+    import inspect
+    if "partial_restore" in inspect.signature(
+            ocp.args.PyTreeRestore.__init__).parameters:
+        restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+        with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+            out = ckptr.restore(
+                path,
+                args=ocp.args.PyTreeRestore(item=target,
+                                            restore_args=restore_args,
+                                            partial_restore=True))
+        return out["params"]
+    # older orbax cannot restore a subtree of a saved tree: fall back to
+    # a full host restore and shard just the params onto `mesh` (reads
+    # the optimizer bytes too — correctness identical, IO not minimal)
     with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
-        out = ckptr.restore(
-            os.path.join(directory, str(step), "default"),
-            args=ocp.args.PyTreeRestore(item=target,
-                                        restore_args=restore_args,
-                                        partial_restore=True))
-    return out["params"]
+        out = ckptr.restore(path)
+    return jax.tree.map(lambda sds, x: jax.device_put(x, sds.sharding),
+                        target["params"], out["params"])
 
 
 def restore_ema_params(checkpoint_dir: str | os.PathLike,

@@ -192,3 +192,21 @@ def test_best_of_ranks_by_mean_logprob(params):
     finally:
         front.stop()
         srv.stop()
+
+
+def test_beam_tiny_vocab_rejected(params):
+    """ADVICE r5: 2*k > vocab_size breaks the 2k-candidate selection
+    (NEG_INF dead-beam candidates get picked, yielding duplicate
+    hypotheses silently) — it must be a trace-time ValueError."""
+    tiny = ModelConfig(
+        vocab_size=6, embed_dim=32, num_layers=1, num_heads=2,
+        num_kv_heads=2, head_dim=8, mlp_dim=32, max_seq_len=64,
+        dtype="float32", param_dtype="float32", remat="none")
+    tiny_params = transformer.init_params(tiny, jax.random.key(0))
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+    with pytest.raises(ValueError, match="vocab"):
+        beam_search(tiny_params, prompt, cfg=tiny, k=4, max_new=4)
+    # at the boundary (2*k == V) the search still runs
+    toks, scores = beam_search(tiny_params, prompt, cfg=tiny, k=3,
+                               max_new=4)
+    assert toks.shape == (1, 3, 4)

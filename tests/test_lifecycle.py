@@ -213,6 +213,54 @@ def test_drain_with_background_thread(params):
         assert len(r.tokens) == 6
 
 
+def test_drain_then_resume_accepts_again(params):
+    """ADVICE r5: a successful drain quiesces (submission refused) and
+    resume() reopens it WITHOUT a stop/start cycle — on both servers."""
+    from cloud_server_tpu.inference.server import InferenceServer
+    paged = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    contig = InferenceServer(params, CFG, GREEDY, max_slots=2, max_len=64,
+                             prompt_buckets=[16])
+    for srv in (paged, contig):
+        r1 = srv.submit(PROMPT, max_new_tokens=4)
+        assert srv.drain(timeout=120) is True
+        assert len(r1.tokens) == 4
+        with pytest.raises(RuntimeError, match="draining"):
+            srv.submit(PROMPT, max_new_tokens=2)
+        srv.resume()
+        r2 = srv.submit(PROMPT, max_new_tokens=4)
+        srv.run_until_idle()
+        assert r2.tokens == r1.tokens
+        srv.stop()
+
+
+def test_stop_drain_timeout_latches_draining(params):
+    """ADVICE r5: stop(drain=True, timeout=...)'s timed-out drain must
+    NOT reopen submission before _stop is set — no request may be
+    accepted just to be failed. The internal latch is what closes the
+    window; verify it directly (deterministic), on both servers."""
+    from cloud_server_tpu.inference.server import InferenceServer
+    paged = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    contig = InferenceServer(params, CFG, GREEDY, max_slots=2, max_len=64,
+                             prompt_buckets=[16])
+    for srv in (paged, contig):
+        r = srv.submit(PROMPT, max_new_tokens=8)
+        # the stop(drain=True) path: a timed-out drain keeps _draining
+        assert srv.drain(timeout=0.0, _resume_on_timeout=False) is False
+        with pytest.raises(RuntimeError, match="draining"):
+            srv.submit(PROMPT, max_new_tokens=2)  # the race window
+        srv.stop()  # fails the straggler, unblocks its waiter
+        assert r.done and r.finish_reason.startswith("error")
+        # and the PUBLIC drain contract still resumes on timeout
+        srv2 = (PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+                if srv is paged else
+                InferenceServer(params, CFG, GREEDY, max_slots=2,
+                                max_len=64, prompt_buckets=[16]))
+        srv2.submit(PROMPT, max_new_tokens=8)
+        assert srv2.drain(timeout=0.0) is False
+        srv2.submit(PROMPT, max_new_tokens=2)  # accepted again
+        srv2.stop()
+
+
 def test_contiguous_server_cancel(params):
     """The contiguous server shares the cancel surface: pending finishes
     immediately, active slots release at the next step."""

@@ -184,3 +184,59 @@ def test_compiled_on_tpu_wide_kernel():
                        tables, 0)
     np.testing.assert_allclose(np.asarray(got8, np.float32), want8,
                                atol=5e-2, rtol=5e-2)
+
+
+def _ragged_ref(q, k_pool, v_pool, lengths, tables, widths, layer):
+    """Row-by-row oracle: each row computed as its OWN uniform-width
+    window (slice the dispatch's W down to widths[b]); rows past their
+    width are unspecified."""
+    outs = []
+    for i in range(q.shape[0]):
+        wi = int(widths[i])
+        row = paged_attention_xla(
+            q[i:i + 1, :max(wi, 1)], k_pool, v_pool, lengths[i:i + 1],
+            tables[i:i + 1], layer)
+        outs.append(row[0])
+    return outs
+
+
+@pytest.mark.parametrize("h,kh", [(4, 4), (4, 2)])
+def test_ragged_widths_xla_matches_per_row(h, kh):
+    """The mixed scheduler's dispatch shape: decode rows (width 1) and
+    prefill rows (width = chunk) in ONE call via per-row `widths` —
+    every valid query must equal the row's own uniform-width dispatch."""
+    w = 6
+    q, k_pool, v_pool, lengths, tables = _make_case(
+        jax.random.key(3), w=w, h=h, kh=kh)
+    widths = jnp.asarray([1, 6, 3], jnp.int32)
+    # lengths INCLUDE the row's own window: re-derive from a base
+    base = jnp.asarray([5, 9, 2], jnp.int32)
+    lengths = base + widths
+    got = paged_attention_xla(q, k_pool, v_pool, lengths, tables, 0,
+                              widths=widths)
+    refs = _ragged_ref(q, k_pool, v_pool, lengths, tables, widths, 0)
+    for i in range(q.shape[0]):
+        wi = int(widths[i])
+        np.testing.assert_allclose(got[i, :wi], refs[i][:wi],
+                                   atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("narrow", [True, False])
+def test_ragged_widths_kernel_matches_xla(narrow):
+    """Pallas kernels (narrow batch-unrolled AND wide grid variants)
+    implement the identical ragged rule as the XLA fallback."""
+    w = 6 if narrow else 40  # > _NARROW_MAX_W selects the wide kernel
+    q, k_pool, v_pool, _, tables = _make_case(
+        jax.random.key(4), w=w, mp=8, num_pages=40)
+    widths = jnp.asarray([1, w, w // 2], jnp.int32)
+    base = jnp.asarray([7, 3, 11], jnp.int32)
+    lengths = base + widths
+    got = paged_attention(q, k_pool, v_pool, lengths, tables, 0,
+                          pages_per_block=2, interpret=True,
+                          widths=widths)
+    want = paged_attention_xla(q, k_pool, v_pool, lengths, tables, 0,
+                               widths=widths)
+    for i in range(q.shape[0]):
+        wi = int(widths[i])
+        np.testing.assert_allclose(got[i, :wi], want[i, :wi],
+                                   atol=2e-4, rtol=2e-4)

@@ -102,3 +102,50 @@ def test_router_embeddings_and_adapters(params):
             for _ in range(4)]
     r.run_until_idle()
     assert all(len(q.tokens) == 4 for q in reqs)
+
+
+def test_burst_submit_sees_inflight_picks():
+    """ADVICE r5: a submit still blocked inside its replica (the router
+    lock is not held across replica.submit) must be visible to
+    concurrent _pick()s via the in-router in-flight counter — otherwise
+    a burst piles onto the replica whose queue insert is slowest.
+
+    Stubs make the race deterministic: replica A's submit blocks on a
+    gate while replica B starts one request more loaded. The second
+    submit must see A's in-flight pick (load 0+1) tie with B and rotate
+    to B — without the counter it reads A as empty and piles on."""
+    import threading
+    import time as _time
+
+    class _Stub:
+        def __init__(self, preload=0):
+            self.got = []
+            self.gate = threading.Event()
+            self.gate.set()
+            self.num_active = 0
+            self._preload = preload
+
+        @property
+        def num_pending(self):
+            return len(self.got) + self._preload
+
+        def submit(self, prompt, **kw):
+            assert self.gate.wait(10)
+            self.got.append(prompt)
+            return prompt
+
+    a, b = _Stub(), _Stub(preload=1)
+    a.gate.clear()  # A's first submit hangs inside the replica
+    router = ReplicatedRouter([a, b])
+    t = threading.Thread(target=lambda: router.submit([1]))
+    t.start()
+    deadline = _time.time() + 10
+    while not any(router._inflight) and _time.time() < deadline:
+        _time.sleep(0.001)
+    assert router._inflight == [1, 0]  # picked A (least loaded), mid-flight
+    router.submit([2])  # must NOT pile onto A
+    assert b.got == [[2]]
+    a.gate.set()
+    t.join(10)
+    assert a.got == [[1]]
+    assert router._inflight == [0, 0]  # settled after both complete
