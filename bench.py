@@ -446,11 +446,15 @@ def _churn_scenario(params, base, infer_cfg, scheduler):
                         interleaved += 1
         srv.run_until_idle()
         dt = time.perf_counter() - t0
+        snap = srv.metrics_snapshot()  # server-side telemetry, pre-stop
+        flight = srv.flight_window()
         srv.stop()
-        return first, waves, dt, interleaved, dec_tok_adm, t_adm
+        return first, waves, dt, interleaved, dec_tok_adm, t_adm, \
+            snap, flight
 
     scenario()  # warm-up: every prefill/decode shape compiles here
-    first, waves, dt, interleaved, dec_tok_adm, t_adm = scenario()
+    (first, waves, dt, interleaved, dec_tok_adm, t_adm,
+     snap, flight) = scenario()
 
     total = sum(len(r.tokens) for r in first + waves)
 
@@ -463,6 +467,28 @@ def _churn_scenario(params, base, infer_cfg, scheduler):
     itls = []
     for r in first:
         itls += [b - a for a, b in zip(r.emit_times, r.emit_times[1:])]
+
+    # Server-side lifecycle telemetry vs the external measurement: the
+    # in-server TTFT histogram observed emit_times[0] - submit_time at
+    # emit time, so its mean over ALL requests must agree with the same
+    # quantity recomputed here from the request objects — a disagreement
+    # means the telemetry path dropped or double-counted observations.
+    from cloud_server_tpu.utils.serving_metrics import (
+        histogram_percentile)
+    h_ttft = snap["cloud_server_ttft_seconds"]
+    h_itl = snap["cloud_server_itl_seconds"]
+    ext_ttft = [r.emit_times[0] - r.submit_time
+                for r in first + waves if r.emit_times]
+    assert h_ttft["count"] == len(ext_ttft), (
+        f"server TTFT count {h_ttft['count']} != external "
+        f"{len(ext_ttft)}")
+    ext_mean = sum(ext_ttft) / len(ext_ttft)
+    srv_mean = h_ttft["sum"] / h_ttft["count"]
+    assert abs(srv_mean - ext_mean) <= 0.05 * ext_mean + 5e-3, (
+        f"server TTFT mean {srv_mean * 1e3:.1f} ms disagrees with "
+        f"external {ext_mean * 1e3:.1f} ms")
+    util = [rec["budget_utilization"] for rec in flight
+            if "budget_utilization" in rec]
     return {"churn_tok_s": total / dt,
             "churn_decode_steps_during_admission": interleaved,
             "churn_decode_tok_s_during_admission":
@@ -470,7 +496,17 @@ def _churn_scenario(params, base, infer_cfg, scheduler):
             "churn_ttft_ms_p50": pct(ttfts, 0.50) * 1e3,
             "churn_ttft_ms_p95": pct(ttfts, 0.95) * 1e3,
             "churn_itl_ms_p50": pct(itls, 0.50) * 1e3,
-            "churn_itl_ms_p99": pct(itls, 0.99) * 1e3}
+            "churn_itl_ms_p99": pct(itls, 0.99) * 1e3,
+            # server-side histogram view (validated against external)
+            "churn_srv_ttft_ms_mean": srv_mean * 1e3,
+            "churn_srv_ttft_ms_p95":
+                histogram_percentile(h_ttft, 0.95) * 1e3,
+            "churn_srv_itl_ms_p50":
+                histogram_percentile(h_itl, 0.50) * 1e3,
+            "churn_srv_itl_ms_p99":
+                histogram_percentile(h_itl, 0.99) * 1e3,
+            "churn_budget_utilization_mean":
+                sum(util) / len(util) if util else 0.0}
 
 
 def _trained_spec_bench():

@@ -196,10 +196,18 @@ class InferConfig:
     # effectively work-conserving; set lower to trade admission speed
     # for a per-iteration latency (ITL) bound.
     mixed_token_budget: int = 0
+    # Scheduler flight recorder: how many per-iteration records the
+    # paged server's ring buffer retains for /stats post-mortems
+    # (token-budget utilization, prefill/decode split, occupancy,
+    # compaction, preemptions). Constructor argument of the same name
+    # overrides; records are small dicts, so even thousands are cheap.
+    flight_recorder_size: int = 256
 
     def __post_init__(self) -> None:
         if self.scheduler not in ("mixed", "alternating"):
             raise ValueError(f"unknown scheduler: {self.scheduler!r}")
+        if self.flight_recorder_size <= 0:
+            raise ValueError("flight_recorder_size must be positive")
 
 
 def to_json(cfg: Any) -> str:

@@ -132,6 +132,23 @@ def build_parser() -> argparse.ArgumentParser:
                    "useful for smoke tests)")
     p.add_argument("--num-draft", type=int, default=4,
                    help="draft tokens proposed per speculative round")
+    p.add_argument("--access-log", metavar="PATH", nargs="?",
+                   const="stderr", default=None,
+                   help="with --serve-http: structured JSON access log "
+                   "(method, path, status, duration, request id), one "
+                   "line per request — to PATH (JSONL file) or, with no "
+                   "value, stderr. Off by default.")
+    p.add_argument("--profiler-port", type=int, default=None,
+                   metavar="PORT",
+                   help="with --serve-http: expose the jax profiler "
+                   "server on PORT for on-demand remote capture "
+                   "(tensorboard profile), alongside the HTTP "
+                   "front-end's own POST /debug/trace")
+    p.add_argument("--flight-recorder", type=int, default=0,
+                   metavar="N",
+                   help="paged server: per-iteration flight-recorder "
+                   "ring size for /stats post-mortems (0 = config "
+                   "default)")
     p.add_argument("--ngram-draft", action="store_true",
                    help="speculative decoding WITHOUT a draft model: "
                    "propose continuations of repeated n-grams from the "
@@ -341,6 +358,7 @@ def main(argv=None) -> None:
             allocation=args.allocation,
             scheduler=args.scheduler,
             mixed_token_budget=args.mixed_token_budget,
+            flight_recorder_size=args.flight_recorder or None,
             draft_params=draft_params, draft_cfg=draft_cfg,
             tokenizer=tok)  # regex-constrained requests compile vs it
 
@@ -353,8 +371,17 @@ def main(argv=None) -> None:
         from cloud_server_tpu.inference.http_server import HttpFrontend
         max_len = args.max_len or model_cfg.max_seq_len
         srv = make_server(max_len, args.max_slots).start()
-        front = HttpFrontend(srv, tokenizer=tok, port=args.serve_http)
+        access_log = (True if args.access_log == "stderr"
+                      else args.access_log)
+        front = HttpFrontend(srv, tokenizer=tok, port=args.serve_http,
+                             access_log=access_log)
         front.start()
+        if args.profiler_port is not None:
+            from cloud_server_tpu.utils.tracing import (
+                start_profiler_server)
+            start_profiler_server(args.profiler_port)
+            print(f"[generate] jax profiler server on port "
+                  f"{args.profiler_port}", file=sys.stderr)
         host, port = front.address
         print(f"[generate] serving on http://{host}:{port} — try:\n"
               f"  curl -N -s {host}:{port}/generate "

@@ -42,9 +42,22 @@ Endpoints:
                     either), OpenAI response shape.
   GET  /v1/models   {"object": "list", "data": [{"id": ...}]}
   GET  /healthz     {"ok": true, "active": N, "pending": N}
-  GET  /metrics     Prometheus text exposition (occupancy, lifetime
-                    token counters, speculation efficiency, preemptions,
-                    prefix-cache hit/miss/eviction counts)
+  GET  /metrics     Full Prometheus text exposition from the backend's
+                    metrics registry: request-lifecycle histograms
+                    (TTFT / inter-token / queue-wait / e2e, with
+                    buckets), occupancy gauges, lifetime counters,
+                    page-pool and prefix-cache stats. Behind a
+                    ReplicatedRouter the snapshot is merged across
+                    replicas (fleet-wide percentiles). Catalog:
+                    docs/observability.md.
+  GET  /stats       JSON aggregates (histogram summaries with
+                    interpolated percentiles, counters, gauges) plus
+                    the scheduler flight recorder's recent window
+                    (?n=K bounds the window, default 64).
+  POST /debug/trace {"steps": N, "logdir": optional} — wrap the next N
+                    scheduler iterations in a jax profiler trace
+                    (utils.tracing.capture_trace); returns the logdir
+                    to point TensorBoard/Perfetto at.
 
 Streaming text is emitted via incremental decode: each chunk is the
 SUFFIX the new tokens added to the decoded string, with a trailing
@@ -62,6 +75,12 @@ slot and pages within one step). When the backend is constructed with
 `max_pending`, submissions past the bound return HTTP 429 — clients
 retry instead of growing host memory.
 
+Access logging is OPT-IN (`HttpFrontend(..., access_log=...)`): one
+structured JSON line per request (method, path, status, duration,
+request id) through utils.logging.JsonLogger; stdlib http.server
+plumbing messages route into the same log. Disabled (the default)
+nothing is printed — the old unconditional silence, now a choice.
+
 Demo (server side: `python -m cloud_server_tpu.generate --serve-http
 8000 ...` or `HttpFrontend(srv, tok).start()`):
 
@@ -76,14 +95,20 @@ Reference parity note: view-sonic/Cloud-Server @ v0 is an empty tree
 from __future__ import annotations
 
 import json
+import os
 import queue
+import tempfile
 import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 from cloud_server_tpu.inference.sampling import SamplingParams
 from cloud_server_tpu.inference.server import QueueFullError
+from cloud_server_tpu.utils.logging import JsonLogger
+from cloud_server_tpu.utils.serving_metrics import (
+    histogram_summary, render_prometheus)
 
 _STREAM_END = object()
 
@@ -217,17 +242,54 @@ class HttpFrontend:
 
     def __init__(self, srv, tokenizer=None,
                  host: str = "127.0.0.1", port: int = 0,
-                 model_id: str = "cloud-server-tpu"):
+                 model_id: str = "cloud-server-tpu",
+                 access_log: bool | str | os.PathLike | JsonLogger
+                 | None = None):
         self.srv = srv
         self.tokenizer = tokenizer
         self.model_id = model_id
+        # opt-in structured access log: True -> JSON lines on stderr,
+        # a path -> JSONL file, or a ready JsonLogger-like object
+        self._owns_log = access_log is True or isinstance(
+            access_log, (str, os.PathLike))
+        if access_log is True:
+            self.access_log = JsonLogger()
+        elif isinstance(access_log, (str, os.PathLike)):
+            self.access_log = JsonLogger(path=access_log)
+        else:
+            self.access_log = access_log or None
         front = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
-            def log_message(self, *args):  # quiet by default
-                pass
+            def log_message(self, fmt, *args):
+                # stdlib plumbing (errors, odd requests): routed into
+                # the structured log when enabled, silent otherwise
+                if front.access_log is not None:
+                    front.access_log.log({"event": "http_log",
+                                          "message": fmt % args})
+
+            def send_response(self, code, message=None):
+                self._status = code  # remembered for the access record
+                super().send_response(code, message)
+
+            def _access(self, method: str, t0: float) -> None:
+                if front.access_log is None:
+                    return
+                front.access_log.log({
+                    "event": "access", "method": method,
+                    "path": self.path,
+                    "status": getattr(self, "_status", None),
+                    "duration_ms": round(
+                        (time.perf_counter() - t0) * 1e3, 3),
+                    "request_id": getattr(self, "_rid", None)})
+
+            def _begin(self) -> float:
+                self._rid = (self.headers.get("X-Request-Id")
+                             or uuid.uuid4().hex[:12])
+                self._status = None
+                return time.perf_counter()
 
             def _json(self, code: int, payload: dict) -> None:
                 body = (json.dumps(payload) + "\n").encode()
@@ -238,11 +300,19 @@ class HttpFrontend:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                t0 = self._begin()
+                try:
+                    self._do_get()
+                finally:
+                    self._access("GET", t0)
+
+            def _do_get(self):
+                url = urlparse(self.path)
+                if url.path == "/healthz":
                     self._json(200, {"ok": True,
                                      "active": front.srv.num_active,
                                      "pending": front.srv.num_pending})
-                elif self.path == "/metrics":
+                elif url.path == "/metrics":
                     body = front._metrics_text().encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
@@ -250,7 +320,14 @@ class HttpFrontend:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
-                elif self.path == "/v1/models":
+                elif url.path == "/stats":
+                    try:
+                        n = int(parse_qs(url.query).get("n", ["64"])[0])
+                    except ValueError:
+                        self._json(400, {"error": '"n" must be an int'})
+                        return
+                    self._json(200, front._stats_json(n))
+                elif url.path == "/v1/models":
                     models = [{"id": front.model_id, "object": "model",
                                "owned_by": "cloud-server-tpu"}]
                     adapters = getattr(front.srv, "adapters", None)
@@ -271,10 +348,18 @@ class HttpFrontend:
                 return body
 
             def do_POST(self):
+                t0 = self._begin()
+                try:
+                    self._do_post()
+                finally:
+                    self._access("POST", t0)
+
+            def _do_post(self):
                 routes = {"/generate": front._handle_generate,
                           "/v1/completions": front._handle_completions,
                           "/v1/chat/completions": front._handle_chat,
-                          "/v1/embeddings": front._handle_embeddings}
+                          "/v1/embeddings": front._handle_embeddings,
+                          "/debug/trace": front._handle_debug_trace}
                 handler = routes.get(self.path)
                 if handler is None:
                     self._json(404, {"error": "unknown path"})
@@ -302,48 +387,66 @@ class HttpFrontend:
 
     # -- shared plumbing ----------------------------------------------------
 
+    def _snapshot(self) -> dict:
+        """The backend's registry snapshot: a server's own, or (behind
+        ReplicatedRouter) the fleet-wide merge. The names are the
+        `cloud_server_` catalog in docs/observability.md (drift-checked
+        by tests/test_observability.py)."""
+        fn = getattr(self.srv, "metrics_snapshot", None)
+        return fn() if fn is not None else {}
+
     def _metrics_text(self) -> str:
-        """Prometheus text exposition of the backend's counters (only
-        the ones the attached server actually has — the two backends
-        differ: the paged server adds speculation/preemption/prefix
-        stats)."""
-        import dataclasses as _dc
-        srv = self.srv
-        out = []
+        """Full Prometheus text exposition (HELP/TYPE per series,
+        histogram buckets with `le` labels plus _sum/_count)."""
+        return render_prometheus(self._snapshot())
 
-        def emit(name, val, help_text, mtype):
-            out.append(f"# HELP cst_{name} {help_text}")
-            out.append(f"# TYPE cst_{name} {mtype}")
-            out.append(f"cst_{name} {val}")
+    def _stats_json(self, n: int) -> dict:
+        """The /stats payload: histogram summaries (count / mean /
+        interpolated p50/p95/p99), raw counters and gauges, and — when
+        the backend has a flight recorder — its last `n` per-iteration
+        records (token-budget utilization, prefill/decode split,
+        occupancy, compaction, preemptions)."""
+        snap = self._snapshot()
+        payload = {
+            "active": self.srv.num_active,
+            "pending": self.srv.num_pending,
+            "latency": {name: histogram_summary(entry)
+                        for name, entry in snap.items()
+                        if entry["type"] == "histogram"},
+            "counters": {name: entry["value"]
+                         for name, entry in snap.items()
+                         if entry["type"] == "counter"},
+            "gauges": {name: entry["value"]
+                       for name, entry in snap.items()
+                       if entry["type"] == "gauge"},
+        }
+        fn = getattr(self.srv, "flight_window", None)
+        if fn is not None:
+            # n bounds the window; n <= 0 means "no records", never
+            # "everything" (256+ per-iteration dicts)
+            payload["flight_recorder"] = fn(n) if n > 0 else []
+        return payload
 
-        def gauge(name, val, help_text):
-            emit(name, val, help_text, "gauge")
-
-        def counter(name, val, help_text):
-            emit(name, val, help_text, "counter")
-
-        gauge("active_slots", srv.num_active, "Requests currently decoding")
-        gauge("pending_requests", srv.num_pending, "Queued requests")
-        counter("tokens_emitted_total", getattr(srv, "tokens_emitted", 0),
-                "Lifetime generated tokens")
-        for attr, help_text in (
-                ("decode_rounds", "Lifetime decode dispatch rounds"),
-                ("decode_tokens_committed",
-                 "Lifetime tokens committed by decode rounds"),
-                ("preemptions", "Lifetime on-demand-paging preemptions")):
-            if hasattr(srv, attr):
-                counter(f"{attr}_total", getattr(srv, attr), help_text)
-        stats_fn = getattr(srv, "prefix_cache_stats", None)
-        if stats_fn is not None:
-            monotonic = ("prefix_hit_pages", "prefix_miss_pages",
-                         "evictions")
-            for k, v in _dc.asdict(stats_fn()).items():
-                if isinstance(v, (int, float)):
-                    kind = counter if k in monotonic else gauge
-                    suffix = "_total" if k in monotonic else ""
-                    kind(f"prefix_cache_{k}{suffix}", v,
-                         f"Prefix cache {k.replace('_', ' ')}")
-        return "\n".join(out) + "\n"
+    def _handle_debug_trace(self, handler, body: dict) -> None:
+        """POST /debug/trace: wrap the next N scheduler iterations in a
+        jax profiler trace. Body: {"steps": N (default 1), "logdir":
+        path (default a fresh tempdir)}; the response echoes the logdir
+        to open in TensorBoard/Perfetto."""
+        fn = getattr(self.srv, "request_trace", None)
+        if fn is None:
+            raise ValueError(
+                "this serving backend does not support trace capture")
+        steps = body.get("steps", 1)
+        if not isinstance(steps, int) or steps <= 0:
+            raise ValueError('"steps" must be a positive int')
+        logdir = body.get("logdir")
+        if logdir is None:
+            logdir = tempfile.mkdtemp(prefix="cloud-server-trace-")
+        elif not isinstance(logdir, str):
+            raise ValueError('"logdir" must be a string path')
+        fn(steps, logdir)
+        handler._json(200, {"ok": True, "steps": steps,
+                            "logdir": logdir})
 
     def _encode(self, req: dict) -> list[int]:
         if "tokens" in req:
@@ -751,3 +854,6 @@ class HttpFrontend:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        if self._owns_log and self.access_log is not None:
+            self.access_log.close()
+            self.access_log = None

@@ -78,6 +78,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import os
 import threading
 import time
 from functools import partial
@@ -97,10 +98,12 @@ from cloud_server_tpu.inference.sampling import (
     sample_logits, sample_logits_rows, sampling_probs,
     sampling_probs_rows)
 from cloud_server_tpu.inference.server import (
-    QueueFullError, Request, _bucket, _token_logprobs, emit_token,
-    resolve_seed)
+    QueueFullError, Request, _StepTracer, _bucket, _token_logprobs,
+    emit_token, resolve_seed)
 from cloud_server_tpu.inference.speculative import (
     _accept_drafts, _accept_point_mass, _ngram_drafts)
+from cloud_server_tpu.utils.serving_metrics import (
+    FlightRecorder, ServingMetrics)
 
 
 def _pow2_buckets(lo: int, hi: int) -> list[int]:
@@ -797,7 +800,9 @@ class PagedInferenceServer:
                  tokenizer=None, max_pending: int | None = None,
                  admit_decode_chunk: int | None = 1,
                  scheduler: str | None = None,
-                 mixed_token_budget: int | None = None):
+                 mixed_token_budget: int | None = None,
+                 metrics: ServingMetrics | None = None,
+                 flight_recorder_size: int | None = None):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -981,6 +986,19 @@ class PagedInferenceServer:
         self.tokens_emitted = 0  # lifetime emitted tokens (bench/metrics)
         self.preemptions = 0
         self._admit_seq = 0
+        # request-lifecycle telemetry (histograms + counters, observed
+        # at host moments the scheduler already owns — zero extra syncs,
+        # guarded by tests/test_observability.py's dispatch-count test)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.metrics.registry.add_collector(self._collect_metrics)
+        self.tracer = _StepTracer()  # /debug/trace on-demand profiling
+        # scheduler flight recorder: one record per busy iteration
+        # (token-budget utilization, prefill/decode split, occupancy,
+        # compaction, preemptions) for post-mortem churn debugging
+        fr_size = (flight_recorder_size if flight_recorder_size is not None
+                   else infer_cfg.flight_recorder_size)
+        self.flight = FlightRecorder(fr_size)
+        self._iter_stats: dict = {}
 
         self._slots: list[_Slot | None] = [None] * max_slots
         self._jobs: list[_AdmitJob] = []
@@ -1090,6 +1108,11 @@ class PagedInferenceServer:
                 raise QueueFullError(
                     f"pending queue is full ({self.max_pending} requests);"
                     " retry later")
+            # telemetry BEFORE the append: once the request is in the
+            # queue the scheduler thread may admit (even finish) it, and
+            # the timeline must stay in lifecycle order
+            req.record_event("submit", req.submit_time)
+            self.metrics.observe_submit(req)
             self._pending.append(req)
         return req
 
@@ -1104,6 +1127,14 @@ class PagedInferenceServer:
             except ValueError:
                 return  # admitted: the step sweep owns the teardown
         req.finish_reason = "cancelled"
+        self._complete(req)
+
+    def _complete(self, req: Request) -> None:
+        """Terminal bookkeeping for any request leaving the server:
+        observe lifecycle metrics, then unblock waiters. Every path
+        that ends a request (finish / cancel / fail) goes through here
+        so the telemetry can never miss a terminal state."""
+        self.metrics.observe_finish(req)
         req._done.set()
 
     def generate(self, prompts, *, max_new_tokens=None):
@@ -1234,9 +1265,12 @@ class PagedInferenceServer:
         self.state["out_counts"] = oc
 
     def _emit(self, req: Request, token: int, logprob: float) -> bool:
+        n0 = len(req.emit_times)
         done = emit_token(req, token, logprob, self.infer_cfg)
         if not (done and req.finish_reason == "eos"):
             self.tokens_emitted += 1  # stop-truncated tokens still count
+        if len(req.emit_times) > n0:  # a stop match truncates instead
+            self.metrics.observe_emit(req)
         return done
 
     def _committed(self, slot_id: int) -> list[int]:
@@ -1275,7 +1309,7 @@ class PagedInferenceServer:
 
     def _finish(self, slot_id: int) -> None:
         slot = self._release_slot(slot_id, self._committed(slot_id))
-        slot.req._done.set()
+        self._complete(slot.req)
 
     # -- admission ----------------------------------------------------------
 
@@ -1320,7 +1354,7 @@ class PagedInferenceServer:
                         req.finish_reason = (
                             "error: request needs more pages than the "
                             "pool can ever provide")
-                        req._done.set()
+                        self._complete(req)
                         continue
                     break
                 self._pending.popleft()
@@ -1370,6 +1404,9 @@ class PagedInferenceServer:
                 staged.append(slot_id)
         if not staged:
             return
+        now = time.perf_counter()  # one clock read per admission burst
+        for slot_id in staged:
+            self.metrics.observe_admit(self._slots[slot_id].req, now)
         pad_tok = self.infer_cfg.pad_token_id
         if self._mixed_enabled:
             # mixed scheduler: ONE job per slot — progress is
@@ -1440,6 +1477,9 @@ class PagedInferenceServer:
             padded[:g] = a
             return padded
 
+        st = self._iter_stats  # flight recorder: prefill share per iter
+        st.setdefault("scheduler", self.scheduler)
+        st["prefill_tokens"] = st.get("prefill_tokens", 0) + w * g
         chunk = pad_rows(job.rows[:, c * w:(c + 1) * w],
                          self.infer_cfg.pad_token_id)
         g_lens = pad_rows(job.base_lens + c * w, 0)
@@ -1501,7 +1541,7 @@ class PagedInferenceServer:
                     # cache — a resubmit would reuse it)
                     slot = self._release_slot(sid, self._committed(sid))
                     slot.req.finish_reason = "cancelled"
-                    slot.req._done.set()
+                    self._complete(slot.req)
                     continue
                 self.active[sid] = True
                 if self._emit(slot.req, int(job.toks[i]),
@@ -1524,6 +1564,7 @@ class PagedInferenceServer:
         sid = max(candidates, key=lambda s: self._slots[s].admit_seq)
         slot = self._release_slot(sid, self._committed(sid))
         self.preemptions += 1
+        self.metrics.observe_requeue(slot.req, time.perf_counter())
         with self._lock:
             self._pending.appendleft(slot.req)
         return True
@@ -1579,7 +1620,7 @@ class PagedInferenceServer:
                     slot.req.finish_reason = (
                         "error: request needs more pages than the pool "
                         "can ever provide")
-                    slot.req._done.set()
+                    self._complete(slot.req)
                     break
                 n_eff = min(n_eff, r_ok)
                 break
@@ -1657,6 +1698,12 @@ class PagedInferenceServer:
             n = max(1, n)
         (live_ids, sl, live_g, lengths, tables, last_np, stop, samp_g,
          gid_np, aid_np) = self._gather_decode_rows()
+        self._iter_stats.update(
+            scheduler=self.scheduler, n_live=len(live_ids),
+            decode_rounds=n,
+            decode_tokens=len(live_ids) * self.window * n,
+            decode_rows=int(live_g.shape[0]),
+            compaction_ratio=len(live_ids) / max(int(live_g.shape[0]), 1))
         args = (jnp.asarray(lengths), jnp.asarray(tables),
                 jnp.asarray(last_np), jnp.asarray(live_g))
         samp = jax.tree.map(jnp.asarray, samp_g)
@@ -1789,6 +1836,10 @@ class PagedInferenceServer:
             sel = [(job, take)]
         if not sel and not n_rounds:
             return
+        self._iter_stats.update(
+            scheduler="mixed", n_live=n_live, decode_rounds=n_rounds,
+            decode_tokens=n_live * self.window * n_rounds,
+            prefill_tokens=sum(t for _, t in sel))
 
         # -- ragged prefill group (one row per selected admission) ----------
         pad_tok = self.infer_cfg.pad_token_id
@@ -1848,6 +1899,10 @@ class PagedInferenceServer:
         # -- decode half (compacted: one row per live slot) -----------------
         (live_ids, sl_d, live_g, d_lens, d_tables, d_last, d_stop,
          samp_d, gid_d, aid_d) = self._gather_decode_rows()
+        self._iter_stats.update(
+            decode_rows=int(live_g.shape[0]) if n_rounds else 0,
+            compaction_ratio=(n_live / max(int(live_g.shape[0]), 1)
+                              if n_rounds else 1.0))
         if n_rounds == 0:
             live_g = np.zeros_like(live_g)
         use_rows_d = bool((self._needs_rows & live).any())
@@ -1909,7 +1964,7 @@ class PagedInferenceServer:
             if slot.req._cancel.is_set():
                 slot = self._release_slot(sid, self._committed(sid))
                 slot.req.finish_reason = "cancelled"
-                slot.req._done.set()
+                self._complete(slot.req)
             else:
                 self.active[sid] = True
                 if self._emit(slot.req, int(job.toks[0]),
@@ -1930,7 +1985,7 @@ class PagedInferenceServer:
                     and sid not in job_slots):
                 slot = self._release_slot(sid, self._committed(sid))
                 slot.req.finish_reason = "cancelled"
-                slot.req._done.set()
+                self._complete(slot.req)
 
     def step(self) -> int:
         """One scheduler iteration: reap cancellations, start
@@ -1940,16 +1995,113 @@ class PagedInferenceServer:
         or the alternating scheduler) prefill chunks and a multi-round
         decode dispatch run separately. Thread-safe."""
         with self._step_lock:
-            self._sweep_cancelled()
-            self._start_admissions()
-            if self._mixed_enabled and self._jobs:
-                self._mixed_dispatch()
-            else:
-                for job in list(self._jobs):
-                    self._run_one_chunk(job)
-                if self.active.any():
-                    self._decode_dispatch()
-            return self.num_active
+            self.tracer.step_start()
+            try:
+                self._sweep_cancelled()
+                self._start_admissions()
+                self._iter_stats = {}
+                p0 = self.preemptions
+                t0 = time.perf_counter()
+                if self._mixed_enabled and self._jobs:
+                    self._mixed_dispatch()
+                else:
+                    for job in list(self._jobs):
+                        self._run_one_chunk(job)
+                    if self.active.any():
+                        self._decode_dispatch()
+                self._record_iteration(t0, p0)
+                return self.num_active
+            finally:
+                self.tracer.step_end()
+
+    def _record_iteration(self, t0: float, p0: int) -> None:
+        """Flight-recorder epilogue for one busy scheduler iteration:
+        the dispatch paths filled `_iter_stats` with their token split;
+        this adds the budget/occupancy derived fields and appends ONE
+        ring-buffer record. Idle iterations (nothing dispatched) leave
+        `_iter_stats` empty and record nothing, so the ring holds the
+        last N *busy* iterations."""
+        st = self._iter_stats
+        if not st:
+            return
+        decode_tokens = st.get("decode_tokens", 0)
+        st["tokens_scheduled"] = decode_tokens + st.get("prefill_tokens", 0)
+        if st.get("scheduler") == "mixed":
+            st["budget_tokens"] = self.mixed_token_budget
+            st["budget_utilization"] = (st["tokens_scheduled"]
+                                        / self.mixed_token_budget)
+        # every preemption requeues its request at the queue front, so
+        # this single field IS both the preemption and the requeue count
+        st["preemptions"] = self.preemptions - p0
+        st["n_jobs"] = len(self._jobs)
+        st["pending"] = self.num_pending
+        st["duration_ms"] = (time.perf_counter() - t0) * 1e3
+        st["ts"] = time.time()
+        self.flight.record(**st)
+
+    # -- observability ------------------------------------------------------
+
+    def _collect_metrics(self) -> None:
+        """Scrape-path mirror of host scheduler + allocator state into
+        the registry (never touched on the serving hot path)."""
+        reg = self.metrics.registry
+        reg.gauge("active_slots",
+                  "Requests currently decoding").set(self.num_active)
+        reg.gauge("pending_requests",
+                  "Queued requests awaiting admission").set(
+                      self.num_pending)
+        reg.gauge("admission_jobs",
+                  "Chunked-prefill admission jobs in flight").set(
+                      len(self._jobs))
+        reg.counter("tokens_emitted_total",
+                    "Lifetime generated tokens").set_total(
+                        self.tokens_emitted)
+        reg.counter("decode_rounds_total",
+                    "Lifetime decode dispatch rounds").set_total(
+                        self.decode_rounds)
+        reg.counter("decode_tokens_committed_total",
+                    "Lifetime tokens committed by decode rounds"
+                    ).set_total(self.decode_tokens_committed)
+        reg.counter("preemptions_total",
+                    "Lifetime on-demand-paging preemptions").set_total(
+                        self.preemptions)
+        stats = self.allocator.stats()
+        reg.gauge("pages_total",
+                  "KV page pool size").set(stats.pages_total)
+        reg.gauge("pages_free",
+                  "Unallocated KV pages").set(stats.pages_free)
+        reg.gauge("pages_cached",
+                  "Refcount-0 prefix-cached KV pages (evictable)").set(
+                      stats.pages_cached)
+        reg.gauge("pages_active",
+                  "KV pages referenced by live slots").set(
+                      stats.pages_active)
+        reg.counter("prefix_hit_pages_total",
+                    "Admission pages served from the radix prefix cache"
+                    ).set_total(stats.prefix_hit_pages)
+        reg.counter("prefix_miss_pages_total",
+                    "Admission pages that missed the radix prefix cache"
+                    ).set_total(stats.prefix_miss_pages)
+        reg.counter("prefix_evictions_total",
+                    "Prefix-cache pages evicted under memory pressure"
+                    ).set_total(stats.evictions)
+
+    def metrics_snapshot(self) -> dict:
+        """Mergeable snapshot of every registered metric (the /metrics
+        and /stats source; ReplicatedRouter merges these across
+        replicas)."""
+        return self.metrics.registry.snapshot()
+
+    def flight_window(self, n: int | None = None) -> list[dict]:
+        """The last `n` (default: all retained) per-iteration flight
+        recorder records, oldest first."""
+        return self.flight.window(n)
+
+    def request_trace(self, n_steps: int,
+                      logdir: str | os.PathLike) -> None:
+        """Arm the /debug/trace capture: the next `n_steps` scheduler
+        iterations run inside utils.tracing.capture_trace(logdir)."""
+        self.tracer.request(n_steps, logdir)
 
     def run_until_idle(self) -> None:
         while self.num_pending or self.num_active or self._jobs:
@@ -1967,11 +2119,11 @@ class PagedInferenceServer:
                 # cache as valid KV
                 slot = self._release_slot(sid, [])
                 slot.req.finish_reason = f"error: {exc!r}"
-                slot.req._done.set()
+                self._complete(slot.req)
         self._jobs.clear()
         for req in pending:
             req.finish_reason = f"error: {exc!r}"
-            req._done.set()
+            self._complete(req)
 
     def serve_forever(self, idle_sleep_s: float = 0.002) -> None:
         while not self._stop.is_set():

@@ -138,6 +138,27 @@ class ReplicatedRouter:
     def tokens_emitted(self) -> int:
         return sum(r.tokens_emitted for r in self.replicas)
 
+    def metrics_snapshot(self) -> dict:
+        """FLEET-wide metrics: every replica's registry snapshot merged
+        (histogram buckets add bucket-for-bucket — identical fixed
+        ladders by construction — so a dp deployment's /metrics reports
+        true fleet percentiles, not replica-0's)."""
+        from cloud_server_tpu.utils.serving_metrics import merge_snapshots
+        return merge_snapshots(
+            r.metrics_snapshot() for r in self.replicas
+            if hasattr(r, "metrics_snapshot"))
+
+    def flight_window(self, n: int | None = None) -> list[dict]:
+        """Recent flight-recorder records across the fleet, each tagged
+        with its replica index, ordered by wall-clock timestamp."""
+        out = []
+        for i, r in enumerate(self.replicas):
+            fn = getattr(r, "flight_window", None)
+            if fn is not None:
+                out += [{"replica": i, **rec} for rec in fn(n)]
+        out.sort(key=lambda rec: rec.get("ts", 0.0))
+        return out
+
     def step(self) -> int:
         busy = 0
         for r in self.replicas:

@@ -38,8 +38,10 @@ from __future__ import annotations
 import collections
 import dataclasses
 import itertools
+import os
 import threading
 import time
+import uuid
 from functools import partial
 from typing import Callable, Sequence
 
@@ -53,6 +55,7 @@ from cloud_server_tpu.inference import engine
 from cloud_server_tpu.inference.sampling import (
     SamplingParams, SamplingRows, make_rows, sample_logits,
     sample_logits_rows, set_rows, zero_rows)
+from cloud_server_tpu.utils.serving_metrics import ServingMetrics
 
 
 def _token_logprobs(logits: jnp.ndarray, toks: jnp.ndarray) -> jnp.ndarray:
@@ -361,6 +364,67 @@ def _deactivate(state: SlotState, slot: jnp.ndarray) -> SlotState:
                      out_counts=state.out_counts)
 
 
+class _StepTracer:
+    """On-demand profiling of the next N scheduler iterations into a
+    jax profiler trace (utils.tracing.capture_trace), armed from any
+    thread (the HTTP /debug/trace endpoint) and driven by the
+    scheduler's own step() — the capture window aligns exactly with
+    iteration boundaries, so a dump shows whole dispatches, not
+    fragments. Trace failures are swallowed with a stderr note: the
+    profiler is process-global and telemetry must never take the
+    scheduler (and every in-flight request) down with it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: tuple[int, str] | None = None
+        self._cm = None
+        self._left = 0
+
+    def request(self, n_steps: int, logdir: str | os.PathLike) -> None:
+        if n_steps <= 0:
+            raise ValueError("trace step count must be positive")
+        with self._lock:
+            if self._pending is not None or self._cm is not None:
+                raise ValueError("a trace capture is already in progress")
+            self._pending = (int(n_steps), os.fspath(logdir))
+
+    @property
+    def active(self) -> bool:
+        return self._pending is not None or self._cm is not None
+
+    def step_start(self) -> None:
+        with self._lock:
+            if self._pending is None:
+                return
+            n, logdir = self._pending
+            self._pending = None
+            from cloud_server_tpu.utils import tracing
+            try:
+                cm = tracing.capture_trace(logdir)
+                cm.__enter__()
+            except Exception as exc:  # noqa: BLE001 — see class docstring
+                import sys
+                print(f"[server] trace capture failed to start: {exc!r}",
+                      file=sys.stderr)
+                return
+            self._cm, self._left = cm, n
+
+    def step_end(self) -> None:
+        with self._lock:
+            if self._cm is None:
+                return
+            self._left -= 1
+            if self._left > 0:
+                return
+            cm, self._cm = self._cm, None
+            try:
+                cm.__exit__(None, None, None)
+            except Exception as exc:  # noqa: BLE001
+                import sys
+                print(f"[server] trace capture failed to stop: {exc!r}",
+                      file=sys.stderr)
+
+
 class QueueFullError(RuntimeError):
     """submit() refused: the pending queue is at its configured bound.
     Backpressure, not failure — the HTTP front-end maps this to 429 so
@@ -400,6 +464,16 @@ class Request:
     # percentiles are where scheduling stalls show.
     submit_time: float | None = None
     emit_times: list[float] = dataclasses.field(default_factory=list)
+    # lifecycle telemetry: a stable id (access logs / timelines) plus an
+    # event trail of (name, perf_counter time) pairs appended at host
+    # moments the scheduler already owns — submit, every (re-)admission,
+    # first token, preempt-requeue, finish:<reason>. admit_time is the
+    # FIRST admission (queue-wait semantics survive preemption).
+    request_id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex[:12])
+    admit_time: float | None = None
+    events: list[tuple[str, float]] = dataclasses.field(
+        default_factory=list)
     # client-side cancellation: the flag is checked by the scheduler;
     # `_on_cancel` is installed by the owning server at submit so a
     # still-PENDING request can be finished without waiting for a step
@@ -423,6 +497,18 @@ class Request:
     @property
     def cancelled(self) -> bool:
         return self._cancel.is_set()
+
+    def record_event(self, name: str, t: float | None = None) -> None:
+        self.events.append((name, time.perf_counter() if t is None
+                            else t))
+
+    def timeline(self) -> list[tuple[str, float]]:
+        """The request's lifecycle events as (name, perf_counter time)
+        pairs, in the order they happened: "submit", "admit" (repeated
+        on re-admission after a preemption), "first_token",
+        "preempt_requeue", "finish:<reason>". Token-level timing lives
+        in `emit_times`."""
+        return list(self.events)
 
     def latency_stats(self) -> dict | None:
         """TTFT and inter-token-latency summary (seconds); None until
@@ -526,7 +612,8 @@ class InferenceServer:
                  prompt_buckets: Sequence[int] | None = None, seed: int = 0,
                  decode_chunk: int = 1, max_pending: int | None = None,
                  prefix_tokens: Sequence[int] | None = None,
-                 prefix_remainder_cap: int = 1024):
+                 prefix_remainder_cap: int = 1024,
+                 metrics: ServingMetrics | None = None):
         # Serving never needs f32 master weights: pre-cast float32 leaves to
         # the compute dtype once, instead of streaming 2x the bytes and
         # converting on every decode step. QTensor leaves stay quantized
@@ -604,6 +691,12 @@ class InferenceServer:
             self._rem_buckets = ([b for b in self.prompt_buckets
                                   if b < rcap] + [rcap])
         self.tokens_emitted = 0  # lifetime emitted tokens (bench/metrics)
+        # request-lifecycle telemetry: histograms + counters observed at
+        # host moments the scheduler already owns (no extra syncs); the
+        # snapshot is the /metrics + /stats source of truth
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.metrics.registry.add_collector(self._collect_metrics)
+        self.tracer = _StepTracer()  # /debug/trace on-demand profiling
         # backpressure: submit() past this bound raises QueueFullError
         # (HTTP 429); None = unbounded (library use, trusted callers)
         self.max_pending = max_pending
@@ -665,6 +758,11 @@ class InferenceServer:
                 raise QueueFullError(
                     f"pending queue is full ({self.max_pending} "
                     "requests); retry later")
+            # telemetry BEFORE the append: once the request is in the
+            # queue the scheduler thread may admit (even finish) it, and
+            # the timeline must stay in lifecycle order
+            req.record_event("submit", req.submit_time)
+            self.metrics.observe_submit(req)
             self._pending.append(req)
         return req
 
@@ -678,6 +776,14 @@ class InferenceServer:
             except ValueError:
                 return  # active: the step sweep owns the teardown
         req.finish_reason = "cancelled"
+        self._complete(req)
+
+    def _complete(self, req: Request) -> None:
+        """Terminal bookkeeping for any request leaving the server:
+        observe lifecycle metrics (finish reason, e2e latency), then
+        unblock waiters. Every path that ends a request goes through
+        here so the telemetry can never miss a terminal state."""
+        self.metrics.observe_finish(req)
         req._done.set()
 
     def _sweep_cancelled(self) -> None:
@@ -703,18 +809,21 @@ class InferenceServer:
     def _emit(self, req: Request, token: int,
               logprob: float | None = None) -> bool:
         """Record one generated token; True if the request just finished."""
+        n0 = len(req.emit_times)
         done = emit_token(req, token, logprob, self.infer_cfg)
         # count every token the model computed and the stream accepted —
         # a stop-sequence match truncates the request's token list but
         # those tokens were still generated (throughput accounting)
         if not (done and req.finish_reason == "eos"):
             self.tokens_emitted += 1
+        if len(req.emit_times) > n0:  # a stop match truncates instead
+            self.metrics.observe_emit(req)
         return done
 
     def _finish(self, slot: int, req: Request) -> None:
         self._slots[slot] = None
         self.state = _deactivate(self.state, jnp.int32(slot))
-        req._done.set()
+        self._complete(req)
 
     def _admit_pending(self) -> None:
         """Admit every admissible pending request in ONE batched prefill.
@@ -738,6 +847,9 @@ class InferenceServer:
                 group.append((slot, req))
         if not group:
             return
+        now = time.perf_counter()  # one clock read per admission burst
+        for _, req in group:
+            self.metrics.observe_admit(req, now)
         prefixed, plain = [], []
         for gr in group:  # one predicate evaluation per request
             (prefixed if self._use_prefix(gr[1]) else plain).append(gr)
@@ -912,35 +1024,42 @@ class InferenceServer:
         Thread-safe: concurrent callers serialise on an internal lock.
         """
         with self._step_lock:
-            self._sweep_cancelled()
-            self._admit_pending()
-            if self.num_active == 0:
-                return 0
-            n = self._chunk_len()
-            use_rows, use_bias = self._rows_mode()
-            if n == 1:
-                self.state, out = _decode(
-                    self.params, self.state, self._next_rng(),
-                    cfg=self.cfg, infer_cfg=self.infer_cfg,
-                    use_rows=use_rows, use_bias=use_bias)
-                toks, lps = jax.device_get(out)
-                chunk = np.asarray(toks)[None]       # (1, B)
-                lchunk = np.asarray(lps)[None]
-            else:
-                self.state, out = _decode_chunk(
-                    self.params, self.state, self._next_rng(),
-                    cfg=self.cfg, infer_cfg=self.infer_cfg, n_steps=n,
-                    use_rows=use_rows, use_bias=use_bias)
-                toks, lps = jax.device_get(out)
-                chunk = np.asarray(toks)             # (n, B)
-                lchunk = np.asarray(lps)
-            for t in range(chunk.shape[0]):
-                for slot, req in enumerate(self._slots):
-                    if req is not None and self._emit(
-                            req, int(chunk[t, slot]),
-                            float(lchunk[t, slot])):
-                        self._finish(slot, req)
-            return self.num_active
+            self.tracer.step_start()
+            try:
+                return self._step_locked()
+            finally:
+                self.tracer.step_end()
+
+    def _step_locked(self) -> int:
+        self._sweep_cancelled()
+        self._admit_pending()
+        if self.num_active == 0:
+            return 0
+        n = self._chunk_len()
+        use_rows, use_bias = self._rows_mode()
+        if n == 1:
+            self.state, out = _decode(
+                self.params, self.state, self._next_rng(),
+                cfg=self.cfg, infer_cfg=self.infer_cfg,
+                use_rows=use_rows, use_bias=use_bias)
+            toks, lps = jax.device_get(out)
+            chunk = np.asarray(toks)[None]       # (1, B)
+            lchunk = np.asarray(lps)[None]
+        else:
+            self.state, out = _decode_chunk(
+                self.params, self.state, self._next_rng(),
+                cfg=self.cfg, infer_cfg=self.infer_cfg, n_steps=n,
+                use_rows=use_rows, use_bias=use_bias)
+            toks, lps = jax.device_get(out)
+            chunk = np.asarray(toks)             # (n, B)
+            lchunk = np.asarray(lps)
+        for t in range(chunk.shape[0]):
+            for slot, req in enumerate(self._slots):
+                if req is not None and self._emit(
+                        req, int(chunk[t, slot]),
+                        float(lchunk[t, slot])):
+                    self._finish(slot, req)
+        return self.num_active
 
     def _fail_all(self, exc: BaseException) -> None:
         """Unblock every in-flight and pending request after a fatal
@@ -951,10 +1070,43 @@ class InferenceServer:
             if req is not None:
                 self._slots[slot] = None
                 req.finish_reason = f"error: {exc!r}"
-                req._done.set()
+                self._complete(req)
         for req in pending:
             req.finish_reason = f"error: {exc!r}"
-            req._done.set()
+            self._complete(req)
+
+    # -- observability ------------------------------------------------------
+
+    def _collect_metrics(self) -> None:
+        """Scrape-path mirror of host scheduler state into the registry
+        (occupancy gauges + lifetime counters the server already keeps)."""
+        reg = self.metrics.registry
+        reg.gauge("active_slots",
+                  "Requests currently decoding").set(self.num_active)
+        reg.gauge("pending_requests",
+                  "Queued requests awaiting admission").set(
+                      self.num_pending)
+        reg.counter("tokens_emitted_total",
+                    "Lifetime generated tokens").set_total(
+                        self.tokens_emitted)
+        reg.counter("prefix_hits_total",
+                    "Admissions served from the cached prefix"
+                    ).set_total(self.prefix_hits)
+        reg.counter("prefix_misses_total",
+                    "Admissions that missed the cached prefix"
+                    ).set_total(self.prefix_misses)
+
+    def metrics_snapshot(self) -> dict:
+        """Mergeable snapshot of every registered metric (the /metrics
+        and /stats source; ReplicatedRouter merges these across
+        replicas)."""
+        return self.metrics.registry.snapshot()
+
+    def request_trace(self, n_steps: int,
+                      logdir: str | os.PathLike) -> None:
+        """Arm the /debug/trace capture: the next `n_steps` scheduler
+        iterations run inside utils.tracing.capture_trace(logdir)."""
+        self.tracer.request(n_steps, logdir)
 
     def run_until_idle(self) -> None:
         while self.num_pending or self.num_active:

@@ -5,7 +5,21 @@ from cloud_server_tpu.utils.failure import (  # noqa: F401
     TrainingDiverged,
     Watchdog,
 )
-from cloud_server_tpu.utils.logging import MetricLogger, read_jsonl  # noqa: F401
+from cloud_server_tpu.utils.logging import (  # noqa: F401
+    JsonLogger,
+    MetricLogger,
+    read_jsonl,
+)
+from cloud_server_tpu.utils.serving_metrics import (  # noqa: F401
+    LATENCY_BUCKETS,
+    FlightRecorder,
+    MetricsRegistry,
+    ServingMetrics,
+    histogram_percentile,
+    histogram_summary,
+    merge_snapshots,
+    render_prometheus,
+)
 from cloud_server_tpu.utils.metrics import (  # noqa: F401
     DEVICE_PEAK_FLOPS,
     MetricAggregator,

@@ -55,3 +55,47 @@ class MetricLogger:
 def read_jsonl(path: str | os.PathLike) -> list[dict]:
     with open(path) as f:
         return [json.loads(line) for line in f if line.strip()]
+
+
+class JsonLogger:
+    """Structured JSONL event log: one JSON object per line, to a file
+    and/or a stream (default stderr — access logs must not interleave
+    with stdout protocol output like bench JSON lines).
+
+    The serving front-end builds its opt-in HTTP access log on this
+    (method, path, status, duration, request id); anything that wants a
+    machine-readable event trail can reuse it. Writes are serialised by
+    a lock so concurrent handler threads never interleave lines."""
+
+    def __init__(self, path: str | os.PathLike | None = None, *,
+                 stream: TextIO | None = None):
+        import threading
+        self._lock = threading.Lock()
+        self._file = open(os.fspath(path), "a") if path is not None else None
+        # explicit stream wins; file-only when a path was given; else
+        # stderr so an argument-free JsonLogger() is still observable
+        self._stream = stream if stream is not None else (
+            None if self._file is not None else sys.stderr)
+
+    def log(self, record: dict) -> None:
+        line = json.dumps({"time": time.time(), **record})
+        with self._lock:
+            if self._file is not None:
+                self._file.write(line + "\n")
+                self._file.flush()
+            if self._stream is not None:
+                print(line, file=self._stream)
+
+    def close(self) -> None:
+        # under the lock: an unjoined handler thread (daemon HTTP
+        # handlers outlive stop()) may be mid-log()
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    def __enter__(self) -> "JsonLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -242,7 +242,25 @@ def test_metrics_endpoint(frontend):
         text = resp.read().decode()
     lines = dict(ln.rsplit(" ", 1) for ln in text.splitlines()
                  if ln and not ln.startswith("#"))
-    assert float(lines["cst_tokens_emitted_total"]) >= 2
-    assert "cst_active_slots" in lines
+    assert float(lines["cloud_server_tokens_emitted_total"]) >= 2
+    assert "cloud_server_active_slots" in lines
+    # lifecycle histograms are exposed with buckets + sum/count
+    assert float(lines["cloud_server_ttft_seconds_count"]) >= 1
+    assert 'cloud_server_itl_seconds_bucket{le="+Inf"}' in lines
     if hasattr(front.srv, "allocator"):
-        assert "cst_prefix_cache_pages_total" in lines
+        assert "cloud_server_pages_total" in lines
+
+
+def test_stats_endpoint(frontend):
+    front, _ = frontend
+    _post(front, {"tokens": [7, 2, 9], "max_new_tokens": 3})
+    host, port = front.address
+    with urllib.request.urlopen(f"http://{host}:{port}/stats?n=8",
+                                timeout=30) as resp:
+        stats = json.loads(resp.read())
+    assert stats["latency"]["cloud_server_ttft_seconds"]["count"] >= 1
+    assert stats["counters"]["cloud_server_requests_finished_total"] >= 1
+    if hasattr(front.srv, "flight_window"):
+        window = stats["flight_recorder"]
+        assert window and len(window) <= 8
+        assert all("tokens_scheduled" in rec for rec in window)
