@@ -353,6 +353,13 @@ def serving_bench():
         print(f"[serving_bench] churn skipped after error: {exc!r}",
               flush=True)
         out["churn_error"] = repr(exc)[:160]
+    # multi-tenant QoS isolation A/B (same guard discipline)
+    try:
+        out.update(_qos_isolation_bench(params_bf16, base, infer_cfg))
+    except Exception as exc:  # noqa: BLE001
+        print(f"[serving_bench] qos_isolation skipped after error: "
+              f"{exc!r}", flush=True)
+        out["qos_isolation_error"] = repr(exc)[:160]
     return out
 
 
@@ -507,6 +514,105 @@ def _churn_scenario(params, base, infer_cfg, scheduler):
                 histogram_percentile(h_itl, 0.99) * 1e3,
             "churn_budget_utilization_mean":
                 sum(util) / len(util) if util else 0.0}
+
+
+def _qos_isolation_bench(params, base, infer_cfg):
+    """Multi-tenant QoS isolation under overload, A/B over the
+    aggressor: a steady "inter" tenant (interactive, weight 3) decodes
+    while a "scraper" tenant (best_effort, weight 1) floods the queue
+    past slot capacity on a page pool sized to force preemption.
+    Three runs on the QoS-enabled server geometry:
+
+      * aggressor OFF  -> the victim's uncontended tok/s + ITL p99;
+      * aggressor ON, QoS ON  -> fair-share admission + priority
+        preemption protect the victim (scraper slots are the victims);
+      * aggressor ON, QoS OFF -> the FIFO/youngest-preemption control.
+
+    `qos_isolation_ratio` = victim tok/s (aggressor on, QoS on) /
+    victim tok/s (aggressor off) — 1.0 is perfect isolation;
+    `qos_off_isolation_ratio` is the same ratio for the control, so
+    the headline A/B is the gap between the two. Each scenario runs
+    twice (untimed compile warm-up, then timed) like the churn bench."""
+    import dataclasses
+
+    import numpy as np
+
+    from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+
+    cfg = dataclasses.replace(base, decode_attention_impl="pallas")
+    qos_cfg = {"quantum": 64,
+               "tenants": {
+                   "inter": {"weight": 3.0, "priority": "interactive"},
+                   "scraper": {"weight": 1.0,
+                               "priority": "best_effort"}}}
+
+    def scenario(aggressor: bool, qos):
+        # 16 slots x 8 pages/slot worst case = 128; 72 pages forces
+        # on-demand preemption once the flood's chains deepen — the
+        # regime victim selection (priority vs youngest) decides
+        srv = PagedInferenceServer(
+            params, cfg, infer_cfg, max_slots=16, max_context=1024,
+            page_size=128, prefill_chunk=256, decode_chunk=8,
+            prompt_buckets=[64, 256], num_pages=72, qos=qos)
+        rng = np.random.RandomState(0)
+
+        def mk_prompt(n):
+            return [int(x) for x in rng.randint(1, 30000, size=n)]
+
+        victims = [srv.submit(mk_prompt(64), max_new_tokens=512,
+                              tenant="inter") for _ in range(6)]
+        for _ in range(2):
+            srv.step()
+        aggr = ([srv.submit(mk_prompt(64), max_new_tokens=512,
+                            tenant="scraper") for _ in range(24)]
+                if aggressor else [])
+        v0 = sum(len(r.tokens) for r in victims)
+        a0 = sum(len(r.tokens) for r in aggr)
+        t0 = time.perf_counter()
+        for _ in range(16):
+            srv.step()
+        dt = time.perf_counter() - t0
+        v_tok_s = (sum(len(r.tokens) for r in victims) - v0) / dt
+        a_tok_s = (sum(len(r.tokens) for r in aggr) - a0) / dt
+        itls = []
+        for r in victims:
+            gaps = [b - a for a, b in zip(r.emit_times, r.emit_times[1:])
+                    if b >= t0]
+            itls += gaps
+        itls.sort()
+        p99 = itls[min(len(itls) - 1, int(0.99 * len(itls)))] if itls \
+            else 0.0
+        for r in victims + aggr:
+            r.cancel()
+        srv.run_until_idle()
+        srv.stop()
+        return {"victim_tok_s": v_tok_s, "aggressor_tok_s": a_tok_s,
+                "victim_itl_ms_p99": p99 * 1e3}
+
+    out = {}
+    # qos=False force-disables (None would fall back to any
+    # InferConfig.qos_config, silently turning the control arm on)
+    cases = [("alone", False, qos_cfg), ("flood", True, qos_cfg),
+             ("flood_noqos", True, False)]
+    for tag, aggressor, qos in cases:
+        scenario(aggressor, qos)  # warm-up: compile every shape
+        res = scenario(aggressor, qos)
+        out[f"qos_{tag}_victim_tok_s"] = res["victim_tok_s"]
+        out[f"qos_{tag}_itl_ms_p99"] = res["victim_itl_ms_p99"]
+        if aggressor:
+            out[f"qos_{tag}_aggressor_tok_s"] = res["aggressor_tok_s"]
+        print(f"[serving_bench] qos_{tag}: victim "
+              f"{res['victim_tok_s']:.1f} tok/s, itl p99 "
+              f"{res['victim_itl_ms_p99']:.1f} ms, aggressor "
+              f"{res['aggressor_tok_s']:.1f} tok/s", flush=True)
+    alone = max(out["qos_alone_victim_tok_s"], 1e-9)
+    out["qos_isolation_ratio"] = out["qos_flood_victim_tok_s"] / alone
+    out["qos_off_isolation_ratio"] = (
+        out["qos_flood_noqos_victim_tok_s"] / alone)
+    print(f"[serving_bench] qos_isolation_ratio "
+          f"{out['qos_isolation_ratio']:.2f} (qos off: "
+          f"{out['qos_off_isolation_ratio']:.2f})", flush=True)
+    return out
 
 
 def _trained_spec_bench():

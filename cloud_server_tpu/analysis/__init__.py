@@ -1,0 +1,24 @@
+"""Static invariant checks for the serving stack's host hot path.
+
+``python -m cloud_server_tpu.analysis`` scans the per-iteration
+scheduler code registered in ``hot_path.HOT_PATHS`` and exits non-zero
+on any finding; the same gate runs as a tier-1 test
+(``tests/test_analysis.py``).
+
+Everything here is stdlib-only (ast) and never imports jax, numpy, or
+the serving stack: the gate runs inside every test process, so it must
+be fast and must not spend any of the process's vm.max_map_count
+budget on an XLA backend it never uses.
+
+The one checker shipped today is the HOT-PATH SYNC/ALLOCATION lint
+(``hot_path.py``): the schedulers are engineered around one
+host<->device sync per iteration, and the QoS admission policy
+(``inference/qos.py``) rides INSIDE that iteration — so the functions
+listed in ``HOT_PATHS`` must stay free of device work, blocking
+transfers, numpy-buffer materialization, wall-clock reads, and host
+I/O. The dispatch-count regression tests sample this dynamically on
+one path; the lint enforces it across every registered function.
+"""
+
+from cloud_server_tpu.analysis.hot_path import (  # noqa: F401
+    Finding, HOT_PATHS, check_hot_paths, check_source)

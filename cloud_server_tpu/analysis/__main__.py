@@ -1,0 +1,25 @@
+"""CLI: ``python -m cloud_server_tpu.analysis [repo_root]``.
+
+Exit status 0 = every registered hot-path function is clean; 1 = at
+least one finding (each printed as ``path:line: [symbol] message``).
+"""
+
+import sys
+
+from cloud_server_tpu.analysis.hot_path import check_hot_paths
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else None
+    findings = check_hot_paths(root)
+    for f in findings:
+        print(f, file=sys.stderr)
+    if findings:
+        print(f"[analysis] {len(findings)} hot-path finding(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -1,0 +1,198 @@
+"""Hot-path sync/allocation lint (checker id: ``hot-path``).
+
+The serving schedulers pay ONE host<->device sync per iteration (the
+device_get of the sampled tokens); everything else in the iteration is
+plain host arithmetic on state the scheduler already owns. The QoS
+layer (``inference/qos.py``) runs inside that iteration — admission
+picks, deficit/virtual-time accounting, token-bucket charges — so its
+hot functions must never reintroduce the per-iteration stalls PR 2
+removed.
+
+``HOT_PATHS`` registers (file, qualname) pairs; inside each listed
+function the lint flags:
+
+  * any use of ``jax`` / ``jnp`` / ``lax`` — device work (dispatches,
+    allocations, or implicit transfers) has no business in host-side
+    policy code;
+  * any use of ``np`` / ``numpy`` — a numpy buffer materialized per
+    call is the allocation class this lint means by "allocation-free"
+    (Python's own objects — small dicts/lists — are unavoidable and
+    cheap; array buffers are not);
+  * blocking transfers and syncs: ``device_get``,
+    ``block_until_ready``, ``.item()``;
+  * host I/O that stalls the scheduler thread: ``print``, ``open``,
+    ``input``, ``logging`` calls, ``time.sleep``;
+  * ``time.time()`` — the schedulers time with the monotonic clocks
+    (``time.monotonic`` / ``time.perf_counter``), which are allowed;
+    wall-clock reads are not (NTP steps would corrupt token-bucket
+    refill math).
+
+Registered functions are checked for EXISTENCE too: renaming a hot
+function without updating the registry fails the gate, so the lint
+cannot silently rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+# (repo-relative file) -> qualnames whose bodies are per-iteration /
+# per-submit hot path. Keep this in sync with the scheduler: anything
+# called from step()/submit() on every request or iteration belongs
+# here.
+HOT_PATHS: dict[str, tuple[str, ...]] = {
+    "cloud_server_tpu/inference/qos.py": (
+        "TokenBucket._refill",
+        "TokenBucket.level",
+        "TokenBucket.try_consume",
+        "TokenBucket.charge",
+        "TokenBucket.retry_after",
+        "TenantRegistry.resolve",
+        "TenantRegistry.priority_rank",
+        "TenantRegistry.weight",
+        "TenantRegistry.victim_rank",
+        "TenantRegistry._decay_recent",
+        "TenantRegistry.gate_submit",
+        "TenantRegistry.on_pending_removed",
+        "TenantRegistry.on_requeue",
+        "TenantRegistry.next_admission_index",
+        "TenantRegistry._in_budget",
+        "TenantRegistry.charge_admission",
+        "TenantRegistry.order_jobs",
+        "TenantRegistry.charge_prefill",
+        "TenantRegistry.charge_generated",
+        # per-busy-iteration flight-recorder gauge
+        "TenantRegistry.fair_shares",
+        "TenantRegistry._fair_shares_locked",
+    ),
+    "cloud_server_tpu/utils/serving_metrics.py": (
+        "Counter.inc",
+        "Gauge.set",
+        "Histogram.observe",
+        "FlightRecorder.record",
+        "ServingMetrics.observe_submit",
+        "ServingMetrics.observe_admit",
+        "ServingMetrics.observe_emit",
+        "ServingMetrics.observe_requeue",
+        "ServingMetrics.observe_finish",
+    ),
+}
+
+_DEVICE_ROOTS = {"jax", "jnp", "lax"}
+_NUMPY_ROOTS = {"np", "numpy"}
+_SYNC_ATTRS = {"device_get", "block_until_ready", "item"}
+_IO_CALLS = {"print", "open", "input"}
+_LOG_ROOTS = {"logging", "logger", "log"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    symbol: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.symbol}] {self.message}"
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """Dotted name of an expression ('time.time', 'jnp.asarray'), or
+    None for anything that is not a plain attribute chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _check_function(path: str, qual: str,
+                    fn: ast.FunctionDef) -> list[Finding]:
+    out: list[Finding] = []
+
+    def flag(node: ast.AST, msg: str) -> None:
+        out.append(Finding(path, getattr(node, "lineno", fn.lineno),
+                           qual, msg))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            if node.id in _DEVICE_ROOTS:
+                flag(node, f"device-framework use ({node.id}.*) on the "
+                           "host hot path")
+            elif node.id in _NUMPY_ROOTS:
+                flag(node, f"numpy buffer work ({node.id}.*) on the "
+                           "host hot path (allocation per call)")
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            mod = getattr(node, "module", None) or ""
+            names = {a.name.split(".")[0] for a in node.names}
+            roots = _DEVICE_ROOTS | _NUMPY_ROOTS
+            if mod.split(".")[0] in roots or names & roots:
+                flag(node, "device/numpy import inside a hot-path "
+                           "function")
+        elif isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name is None:
+                continue
+            leaf = name.rsplit(".", 1)[-1]
+            root = name.split(".", 1)[0]
+            if leaf in _SYNC_ATTRS:
+                flag(node, f"blocking sync/transfer call {name}()")
+            elif name in _IO_CALLS:
+                flag(node, f"host I/O call {name}() stalls the "
+                           "scheduler thread")
+            elif name == "time.time":
+                flag(node, "wall-clock time.time() — use the monotonic "
+                           "clocks (time.monotonic / perf_counter)")
+            elif name == "time.sleep" or leaf == "sleep":
+                flag(node, f"sleep call {name}() on the hot path")
+            elif root in _LOG_ROOTS or (
+                    "." in name and name.rsplit(".", 2)[-2] in _LOG_ROOTS):
+                flag(node, f"logging call {name}() on the hot path")
+    return out
+
+
+def check_source(path: str, source: str,
+                 qualnames: tuple[str, ...]) -> list[Finding]:
+    """Lint `qualnames` inside `source`; missing qualnames are findings
+    too (the registry must not rot when functions are renamed)."""
+    tree = ast.parse(source, filename=path)
+    found: dict[str, ast.FunctionDef] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                found[prefix + child.name] = child
+                visit(child, prefix + child.name + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, prefix + child.name + ".")
+
+    visit(tree, "")
+    out: list[Finding] = []
+    for qual in qualnames:
+        fn = found.get(qual)
+        if fn is None:
+            out.append(Finding(path, 1, qual,
+                               "registered hot-path function not found "
+                               "(renamed? update HOT_PATHS)"))
+            continue
+        out.extend(_check_function(path, qual, fn))
+    return out
+
+
+def check_hot_paths(root: str | None = None) -> list[Finding]:
+    """Run the lint over every registered file. `root` defaults to the
+    repository root (two levels above this file's package)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    out: list[Finding] = []
+    for rel, quals in HOT_PATHS.items():
+        path = os.path.join(root, rel)
+        with open(path) as f:
+            out.extend(check_source(rel, f.read(), quals))
+    return out

@@ -202,6 +202,15 @@ class InferConfig:
     # compaction, preemptions). Constructor argument of the same name
     # overrides; records are small dicts, so even thousands are cheap.
     flight_recorder_size: int = 256
+    # Multi-tenant QoS (inference/qos.py): a JSON object as a string,
+    # or a path to a JSON file, declaring per-tenant weights, priority
+    # classes, token-bucket rate limits, and pending bounds (schema in
+    # docs/serving.md). "" (the default) disables QoS entirely — the
+    # schedulers run the byte-identical single-tenant FIFO paths. A
+    # string (not a dict) keeps this dataclass hashable for jit static
+    # arguments; servers parse it at construction. Constructor argument
+    # `qos=` overrides.
+    qos_config: str = ""
 
     def __post_init__(self) -> None:
         if self.scheduler not in ("mixed", "alternating"):

@@ -149,6 +149,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="paged server: per-iteration flight-recorder "
                    "ring size for /stats post-mortems (0 = config "
                    "default)")
+    p.add_argument("--qos-config", metavar="FILE_OR_JSON", default=None,
+                   help="multi-tenant QoS: a JSON file path (or inline "
+                   "JSON object) declaring per-tenant weights, priority "
+                   "classes, token-bucket rate limits, pending bounds, "
+                   "and API-key mappings (schema: docs/serving.md). "
+                   "Enables weighted fair-share admission, priority "
+                   "preemption, and per-tenant 429s; omitted, the "
+                   "server runs the byte-identical single-tenant FIFO "
+                   "paths")
     p.add_argument("--ngram-draft", action="store_true",
                    help="speculative decoding WITHOUT a draft model: "
                    "propose continuations of repeated n-grams from the "
@@ -332,7 +341,8 @@ def main(argv=None) -> None:
                 params, model_cfg, infer_cfg, max_slots=max_slots,
                 max_len=max_len, seed=args.seed,
                 decode_chunk=args.decode_chunk,
-                prefix_tokens=prefix_toks)
+                prefix_tokens=prefix_toks,
+                qos=args.qos_config)
         if args.prefix:
             print("[generate] note: the paged server reuses shared "
                   "prefixes automatically (radix page cache); --prefix "
@@ -360,6 +370,7 @@ def main(argv=None) -> None:
             mixed_token_budget=args.mixed_token_budget,
             flight_recorder_size=args.flight_recorder or None,
             draft_params=draft_params, draft_cfg=draft_cfg,
+            qos=args.qos_config,
             tokenizer=tok)  # regex-constrained requests compile vs it
 
     if args.serve_http is not None:

@@ -75,6 +75,20 @@ slot and pages within one step). When the backend is constructed with
 `max_pending`, submissions past the bound return HTTP 429 — clients
 retry instead of growing host memory.
 
+Multi-tenant QoS (inference/qos.py): when the backend carries a
+TenantRegistry, each request's tenant comes from an API key
+(`Authorization: Bearer <key>` / `X-Api-Key`) the registry maps —
+authoritative — or from the `X-Tenant` header, which is trusted only
+for tenants that configured no api_keys (a bare header can never
+impersonate a key-protected tenant); anonymous requests ride the
+implicit default tenant. Every 429 — global bound or per-tenant — is
+structured: a `Retry-After` header (seconds, ceil'd) plus a JSON body
+`{"error", "retry_after_s", "tenant"}`, where per-tenant rejections
+derive `retry_after_s` from the tenant's token-bucket refill. `/stats`
+gains a `tenants` section (per-tenant counters + fair-share view) and
+`/metrics` the tenant-labeled series cataloged in
+docs/observability.md.
+
 Access logging is OPT-IN (`HttpFrontend(..., access_log=...)`): one
 structured JSON line per request (method, path, status, duration,
 request id) through utils.logging.JsonLogger; stdlib http.server
@@ -95,6 +109,7 @@ Reference parity note: view-sonic/Cloud-Server @ v0 is an empty tree
 from __future__ import annotations
 
 import json
+import math
 import os
 import queue
 import tempfile
@@ -291,11 +306,14 @@ class HttpFrontend:
                 self._status = None
                 return time.perf_counter()
 
-            def _json(self, code: int, payload: dict) -> None:
+            def _json(self, code: int, payload: dict,
+                      headers: dict | None = None) -> None:
                 body = (json.dumps(payload) + "\n").encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -364,6 +382,10 @@ class HttpFrontend:
                 if handler is None:
                     self._json(404, {"error": "unknown path"})
                     return
+                # multi-tenant QoS: tenant identity rides on headers
+                # (X-Tenant, or an API key the registry maps), resolved
+                # once per request and threaded into every submit
+                self._tenant = front._resolve_tenant(self.headers)
                 try:
                     body = self._body()
                 except (ValueError, json.JSONDecodeError) as exc:
@@ -378,7 +400,19 @@ class HttpFrontend:
                     # all are client errors, never handler-thread crashes
                     self._json(400, {"error": str(exc)})
                 except QueueFullError as exc:  # backpressure, retryable
-                    self._json(429, {"error": str(exc)})
+                    # structured 429: clients get machine-readable retry
+                    # guidance instead of a bare string. Per-tenant
+                    # rejections (TenantQueueFullError) carry the
+                    # tenant's token-bucket refill estimate; the global
+                    # bound falls back to a 1 s hint.
+                    retry = float(getattr(exc, "retry_after_s", 1.0))
+                    self._json(
+                        429,
+                        {"error": str(exc),
+                         "retry_after_s": round(retry, 3),
+                         "tenant": getattr(exc, "tenant", self._tenant)},
+                        headers={"Retry-After":
+                                 str(max(1, math.ceil(retry)))})
                 except RuntimeError as exc:  # scheduler stopped/crashed
                     self._json(503, {"error": str(exc)})
 
@@ -425,6 +459,18 @@ class HttpFrontend:
             # n bounds the window; n <= 0 means "no records", never
             # "everything" (256+ per-iteration dicts)
             payload["flight_recorder"] = fn(n) if n > 0 else []
+        # multi-tenant QoS: per-tenant counters + fair-share view.
+        # ReplicatedRouter merges these across replicas
+        # (tenant_stats()); a single server reports its registry's.
+        tfn = getattr(self.srv, "tenant_stats", None)
+        if tfn is not None:
+            tstats = tfn()
+            if tstats:
+                payload["tenants"] = tstats
+        else:
+            reg = getattr(self.srv, "qos", None)
+            if reg is not None:
+                payload["tenants"] = reg.stats()
         return payload
 
     def _handle_debug_trace(self, handler, body: dict) -> None:
@@ -461,6 +507,43 @@ class HttpFrontend:
                     'no tokenizer attached; send {"tokens": [...]} instead')
             return self.tokenizer.encode(req["prompt"]) or [0]
         raise ValueError('body needs "prompt" or "tokens"')
+
+    def _resolve_tenant(self, headers) -> str | None:
+        """Tenant identity for one request. An API key
+        (`Authorization: Bearer <key>` or `X-Api-Key`) the backend's
+        TenantRegistry maps is AUTHORITATIVE; the spoofable `X-Tenant`
+        header is honored only for tenants that configured no api_keys
+        (`TenantRegistry.header_trusted`) — claiming a key-protected
+        tenant without its key falls through to anonymous/default
+        instead of riding the protected tenant's weight and budget.
+        With QoS disabled (no registry) every request is anonymous:
+        an attacker-chosen header value must never become a metric
+        label (unbounded per-tenant histogram cardinality) — only a
+        registry's frozen tenant set bounds that. None resolves to the
+        implicit default tenant server-side."""
+        reg = getattr(self.srv, "qos", None)
+        if reg is None:
+            return None
+        auth = headers.get("Authorization", "")
+        # RFC 7235: the auth scheme is case-insensitive
+        key = (auth[7:].strip() if auth[:7].lower() == "bearer "
+               else headers.get("X-Api-Key"))
+        if key:
+            mapped = reg.tenant_for_api_key(key)
+            if mapped:
+                return mapped
+        t = (headers.get("X-Tenant") or "").strip()
+        if t and reg.header_trusted(t):
+            return t
+        return None
+
+    @staticmethod
+    def _tenant_kw(handler) -> dict:
+        """submit() kwargs carrying the handler's resolved tenant —
+        empty when anonymous, so backends without a `tenant` parameter
+        (third-party submit surfaces) keep working untouched."""
+        t = getattr(handler, "_tenant", None)
+        return {"tenant": t} if t else {}
 
     def _adapter_kw(self, body: dict) -> dict:
         """OpenAI routing: a `model` naming a registered LoRA adapter
@@ -506,6 +589,7 @@ class HttpFrontend:
                 raise ValueError(
                     "this serving backend does not support adapters")
             kw["adapter"] = body["adapter"]
+        kw.update(self._tenant_kw(handler))
         request, q = self._submit_streaming(tokens, max_new, sampling,
                                             **kw)
 
@@ -639,7 +723,8 @@ class HttpFrontend:
                 raise ValueError("streaming supports a single prompt with "
                                  "n=1")
             request, q = self._submit_streaming(
-                prompts[0], max_new, sampling, **self._adapter_kw(body))
+                prompts[0], max_new, sampling,
+                **self._adapter_kw(body), **self._tenant_kw(handler))
             self._sse_head(handler)
             stream = _TextStream(self.tokenizer)
             try:
@@ -671,7 +756,7 @@ class HttpFrontend:
                     sampling, seed=(sampling.seed + k) % (2 ** 32))
             return sampling
 
-        akw = self._adapter_kw(body)
+        akw = {**self._adapter_kw(body), **self._tenant_kw(handler)}
         cands, submitted = [], []
         try:
             for p in prompts:
@@ -792,7 +877,8 @@ class HttpFrontend:
 
         if body.get("stream"):
             request, q = self._submit_streaming(
-                prompt, max_new, sampling, **self._adapter_kw(body))
+                prompt, max_new, sampling,
+                **self._adapter_kw(body), **self._tenant_kw(handler))
             self._sse_head(handler)
             stream = _TextStream(self.tokenizer)
             try:
@@ -824,7 +910,8 @@ class HttpFrontend:
 
         req = self.srv.submit(prompt, max_new_tokens=max_new,
                               sampling=sampling,
-                              **self._adapter_kw(body))
+                              **self._adapter_kw(body),
+                              **self._tenant_kw(handler))
         toks = req.result()
         handler._json(200, {
             **base, "object": "chat.completion",

@@ -802,7 +802,8 @@ class PagedInferenceServer:
                  scheduler: str | None = None,
                  mixed_token_budget: int | None = None,
                  metrics: ServingMetrics | None = None,
-                 flight_recorder_size: int | None = None):
+                 flight_recorder_size: int | None = None,
+                 qos=None):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -1007,6 +1008,18 @@ class PagedInferenceServer:
         # (HTTP 429) instead of growing host memory without limit;
         # None = unbounded (library use, trusted callers)
         self.max_pending = max_pending
+        # multi-tenant QoS (inference/qos.py): a TenantRegistry, a
+        # config dict / JSON string / file path, or None (falls back to
+        # InferConfig.qos_config). None disables QoS entirely — every
+        # guarded call site below short-circuits, so the scheduler is
+        # byte-identical to the pre-QoS FIFO/youngest-preemption paths
+        # (pinned by tests/test_mixed_scheduler.py and test_qos.py's
+        # single-tenant parity test). All QoS decisions run on host
+        # state the scheduler already owns: zero extra dispatches or
+        # host syncs (the dispatch-count regression tests cover a
+        # QoS-enabled server too).
+        from cloud_server_tpu.inference.qos import resolve_registry
+        self.qos = resolve_registry(qos, infer_cfg.qos_config)
         self._draining = False
         # admission-latency bound: while prefill jobs are in flight,
         # decode dispatches shrink to this many rounds (default 1) so a
@@ -1064,7 +1077,8 @@ class PagedInferenceServer:
     def submit(self, prompt: Sequence[int], *,
                max_new_tokens: int | None = None, stream=None,
                sampling: SamplingParams | None = None,
-               adapter: str | None = None) -> Request:
+               adapter: str | None = None,
+               tenant: str | None = None) -> Request:
         if self._stop.is_set():
             raise RuntimeError("server is stopped; not accepting requests")
         if (adapter is not None
@@ -1090,8 +1104,16 @@ class PagedInferenceServer:
                     "(completion is signalled by EOS at an accepting "
                     "state)")
             self._grammar_gid(sampling.regex)  # compile now; 400 here
+        if self.qos is not None:
+            tenant = self.qos.resolve(tenant)
+        else:
+            # no registry = no frozen tenant set to bound cardinality:
+            # a caller-supplied string must not mint per-tenant labeled
+            # metric series (observe_emit labels by req.tenant)
+            tenant = None
         req = Request(prompt=list(prompt), max_new_tokens=max_new,
                       stream=stream, sampling=sampling, adapter=adapter,
+                      tenant=tenant,
                       seed_used=resolve_seed(sampling, self._host_rng,
                                              self._lock),
                       submit_time=time.perf_counter())
@@ -1108,6 +1130,14 @@ class PagedInferenceServer:
                 raise QueueFullError(
                     f"pending queue is full ({self.max_pending} requests);"
                     " retry later")
+            if self.qos is not None:
+                # per-tenant backpressure AFTER the global bound: one
+                # tenant at its pending cap or out of prompt-bucket
+                # budget 429s while every other tenant keeps admitting.
+                # On failure nothing was mutated for this request; on
+                # success the tenant's pending count advances atomically
+                # with the append below.
+                self.qos.gate_submit(tenant, len(prompt))
             # telemetry BEFORE the append: once the request is in the
             # queue the scheduler thread may admit (even finish) it, and
             # the timeline must stay in lifecycle order
@@ -1126,6 +1156,8 @@ class PagedInferenceServer:
                 self._pending.remove(req)
             except ValueError:
                 return  # admitted: the step sweep owns the teardown
+            if self.qos is not None:
+                self.qos.on_pending_removed(req.tenant)
         req.finish_reason = "cancelled"
         self._complete(req)
 
@@ -1269,6 +1301,11 @@ class PagedInferenceServer:
         done = emit_token(req, token, logprob, self.infer_cfg)
         if not (done and req.finish_reason == "eos"):
             self.tokens_emitted += 1  # stop-truncated tokens still count
+            if self.qos is not None:
+                # bill the generated token: the tenant's bucket takes
+                # the debt (deprioritizing future admissions) and the
+                # lifetime counter feeds the fair-share stats
+                self.qos.charge_generated(req.tenant)
         if len(req.emit_times) > n0:  # a stop match truncates instead
             self.metrics.observe_emit(req)
         return done
@@ -1331,7 +1368,15 @@ class PagedInferenceServer:
         with self._lock:
             free = [i for i, s in enumerate(self._slots) if s is None]
             while self._pending and free:
-                req = self._pending[0]
+                if self.qos is not None:
+                    # deficit-round-robin over tenants: which pending
+                    # request funds the next free slot (FIFO within a
+                    # tenant; single-tenant degenerates to index 0 —
+                    # exactly the FIFO below)
+                    idx = self.qos.next_admission_index(self._pending)
+                else:
+                    idx = 0
+                req = self._pending[idx]
                 prompt = list(req.prompt) + list(req.tokens)
                 remaining = req.max_new_tokens - len(req.tokens)
                 shared, shared_len = self.allocator.lookup_prefix(
@@ -1350,14 +1395,22 @@ class PagedInferenceServer:
                     if self.num_active == 0 and not self._jobs:
                         # nothing running will ever free pages: the pool
                         # is simply too small for this request
-                        self._pending.popleft()
+                        del self._pending[idx]
+                        if self.qos is not None:
+                            self.qos.on_pending_removed(req.tenant)
                         req.finish_reason = (
                             "error: request needs more pages than the "
                             "pool can ever provide")
                         self._complete(req)
                         continue
                     break
-                self._pending.popleft()
+                del self._pending[idx]
+                if self.qos is not None:
+                    # consume the tenant's DRR deficit only now that
+                    # the admission actually succeeded (a page-famine
+                    # break above leaves it intact for the retry)
+                    self.qos.charge_admission(req.tenant, len(prompt))
+                    self.qos.on_pending_removed(req.tenant)
                 slot_id = free.pop(0)
                 self._admit_seq += 1
                 slot = _Slot(req=req, prompt=prompt,
@@ -1552,19 +1605,35 @@ class PagedInferenceServer:
     # -- decode -------------------------------------------------------------
 
     def _preempt_youngest(self, protect: int) -> bool:
-        """Free the youngest live slot's pages (content-keyed into the
-        radix cache — fully-written, valid KV) and requeue its request
-        at the FRONT of the queue as a continuation. Returns False when
-        no slot other than `protect` can be preempted."""
+        """Free one live slot's pages (content-keyed into the radix
+        cache — fully-written, valid KV) and requeue its request at the
+        FRONT of the queue as a continuation. Victim selection: the
+        YOUNGEST slot (max admit_seq) without QoS; with a TenantRegistry
+        the order becomes (lowest priority class, most over fair share,
+        youngest) — an interactive tenant's slots outlive a best-effort
+        flood's. Returns False when no slot other than `protect` can be
+        preempted."""
         candidates = [sid for sid, s in enumerate(self._slots)
                       if s is not None and self.active[sid]
                       and sid != protect]
         if not candidates:
             return False
-        sid = max(candidates, key=lambda s: self._slots[s].admit_seq)
+        if self.qos is not None:
+            sid = max(candidates,
+                      key=lambda s: (*self.qos.victim_rank(
+                          self._slots[s].req.tenant),
+                          self._slots[s].admit_seq))
+        else:
+            sid = max(candidates, key=lambda s: self._slots[s].admit_seq)
         slot = self._release_slot(sid, self._committed(sid))
         self.preemptions += 1
         self.metrics.observe_requeue(slot.req, time.perf_counter())
+        if self.qos is not None:
+            self.qos.on_requeue(slot.req.tenant)
+            # the flight-recorder iteration record tags preempt-requeues
+            # with the victim tenant (post-mortem: WHO got evicted)
+            self._iter_stats.setdefault("preempt_tenants", []).append(
+                slot.req.tenant)
         with self._lock:
             self._pending.appendleft(slot.req)
         return True
@@ -1818,9 +1887,22 @@ class PagedInferenceServer:
         live = self.active if n_rounds > 0 else np.zeros((b,), bool)
         n_live = int(live.sum())
 
+        jobs = self._jobs
+        if self.qos is not None and jobs:
+            # weighted-fair funding of the iteration's prefill chunks:
+            # jobs ordered by their tenant's prefill virtual time
+            # (spent-tokens / weight; FIFO within a tenant) instead of
+            # plain FIFO — with one tenant the order is the identity,
+            # i.e. exactly the FIFO below. Called even for a single
+            # job: it also advances the global virtual time, so a
+            # tenant arriving after an idle gap resumes at the current
+            # time instead of replaying idle credit.
+            order = self.qos.order_jobs(
+                [self._slots[j.slots[0]].req.tenant for j in jobs])
+            jobs = [self._jobs[i] for i in order]
         sel: list[tuple[_AdmitJob, int]] = []
         left = self.mixed_token_budget - n_live * self.window * n_rounds
-        for job in self._jobs:
+        for job in jobs:
             if left <= 0:
                 break
             rem_left = int(job.rem_lens[0]) - job.done
@@ -1829,13 +1911,17 @@ class PagedInferenceServer:
                 continue
             sel.append((job, take))
             left -= take
-        if self._jobs and not sel:
-            job = self._jobs[0]
+        if jobs and not sel:
+            job = jobs[0]
             take = min(int(job.rem_lens[0]) - job.done,
                        self._rem_buckets[0])
             sel = [(job, take)]
         if not sel and not n_rounds:
             return
+        if self.qos is not None:
+            for job, take in sel:
+                self.qos.charge_prefill(
+                    self._slots[job.slots[0]].req.tenant, take)
         self._iter_stats.update(
             scheduler="mixed", n_live=n_live, decode_rounds=n_rounds,
             decode_tokens=n_live * self.window * n_rounds,
@@ -2033,6 +2119,13 @@ class PagedInferenceServer:
         # every preemption requeues its request at the queue front, so
         # this single field IS both the preemption and the requeue count
         st["preemptions"] = self.preemptions - p0
+        if self.qos is not None:
+            # per-tenant fair-share gauge (generated share over
+            # weighted entitlement, 1.0 = fair) — the post-mortem view
+            # of WHO the iteration's tokens went to
+            st["tenant_fair_share"] = {
+                k: round(v, 4)
+                for k, v in self.qos.fair_shares().items()}
         st["n_jobs"] = len(self._jobs)
         st["pending"] = self.num_pending
         st["duration_ms"] = (time.perf_counter() - t0) * 1e3
@@ -2085,6 +2178,8 @@ class PagedInferenceServer:
         reg.counter("prefix_evictions_total",
                     "Prefix-cache pages evicted under memory pressure"
                     ).set_total(stats.evictions)
+        if self.qos is not None:
+            self.qos.mirror_metrics(reg)
 
     def metrics_snapshot(self) -> dict:
         """Mergeable snapshot of every registered metric (the /metrics
@@ -2122,6 +2217,8 @@ class PagedInferenceServer:
                 self._complete(slot.req)
         self._jobs.clear()
         for req in pending:
+            if self.qos is not None:
+                self.qos.on_pending_removed(req.tenant)
             req.finish_reason = f"error: {exc!r}"
             self._complete(req)
 

@@ -13,7 +13,16 @@ re-built here without any cross-replica NCCL).
 
 Placement: least-loaded (active + pending), round-robin on ties — the
 rotation keeps a cold, empty fleet from piling every request on
-replica 0.
+replica 0. Tenant-tagged submits (multi-tenant QoS, inference/qos.py)
+break ties from the TENANT'S OWN stable home offset instead of the
+global rotation: on an un-loaded fleet a tenant's requests land on the
+same replica first (radix prefix-cache locality for its prompts) while
+load imbalance still dominates the pick the moment it appears. QoS
+limits are PER REPLICA (each replica owns an independent registry):
+token buckets and max_pending bound a tenant on each replica, so its
+fleet-wide ceiling is ~N× the configured value — divide rates by the
+replica count when a fleet-wide bound is the intent. Fair-share
+weights need no scaling (ratios converge per replica).
 
 The router exposes the submit / num_active / num_pending / start /
 stop surface the HTTP front-end expects, so
@@ -28,6 +37,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import zlib
 from typing import Sequence
 
 import jax
@@ -67,10 +77,19 @@ class ReplicatedRouter:
 
     # -- placement ----------------------------------------------------------
 
-    def _pick(self, *, count_inflight: bool = False) -> int:
+    def _pick(self, *, tenant: str | None = None,
+              count_inflight: bool = False) -> int:
         loads = [r.num_active + r.num_pending + inf
                  for r, inf in zip(self.replicas, self._inflight)]
-        k = next(self._rr) % len(self.replicas)
+        if tenant is None:
+            k = next(self._rr) % len(self.replicas)
+        else:
+            # tenant-affinity tie-break: a stable per-tenant home
+            # offset (crc32, not hash() — PYTHONHASHSEED-independent)
+            # so an idle fleet serves a tenant from one replica (its
+            # prompts hit that replica's radix prefix cache) while
+            # least-loaded still wins under any load skew
+            k = zlib.crc32(tenant.encode()) % len(self.replicas)
         # least loaded; ties resolve round-robin from k
         i = min(range(len(loads)),
                 key=lambda i: (loads[i], (i - k) % len(loads)))
@@ -80,7 +99,7 @@ class ReplicatedRouter:
 
     def submit(self, prompt, **kw):
         with self._lock:
-            i = self._pick(count_inflight=True)
+            i = self._pick(tenant=kw.get("tenant"), count_inflight=True)
         try:
             return self.replicas[i].submit(prompt, **kw)
         finally:
@@ -142,11 +161,59 @@ class ReplicatedRouter:
         """FLEET-wide metrics: every replica's registry snapshot merged
         (histogram buckets add bucket-for-bucket — identical fixed
         ladders by construction — so a dp deployment's /metrics reports
-        true fleet percentiles, not replica-0's)."""
+        true fleet percentiles, not replica-0's). The additive gauge
+        merge is wrong for RATIO gauges: `tenant_fair_share` (1.0 =
+        exactly fair) would read ~N for N fair replicas, so it is
+        recomputed from the fleet-merged generated totals
+        (tenant_stats), the same rule that function documents."""
         from cloud_server_tpu.utils.serving_metrics import merge_snapshots
-        return merge_snapshots(
+        merged = merge_snapshots(
             r.metrics_snapshot() for r in self.replicas
             if hasattr(r, "metrics_snapshot"))
+        tstats = self.tenant_stats()
+        for key, entry in merged.items():
+            if not key.startswith("cloud_server_tenant_fair_share{"):
+                continue
+            t = (entry.get("labels") or {}).get("tenant")
+            if t in tstats:
+                entry["value"] = tstats[t]["fair_share"]
+        return merged
+
+    @property
+    def qos(self):
+        """The TenantRegistry view the HTTP front-end resolves API
+        keys against (replica 0's — every replica parses the same
+        config, so the key map agrees fleet-wide)."""
+        return getattr(self.replicas[0], "qos", None)
+
+    def tenant_stats(self) -> dict:
+        """FLEET-wide per-tenant stats: every replica's
+        TenantRegistry.stats() merged — counters sum, weight/priority
+        come from the shared config, and fair_share is recomputed from
+        the merged generated totals (a per-replica ratio would not
+        average meaningfully)."""
+        merged: dict[str, dict] = {}
+        for r in self.replicas:
+            reg = getattr(r, "qos", None)
+            if reg is None:
+                continue
+            for name, s in reg.stats().items():
+                cur = merged.setdefault(name, {
+                    "weight": s["weight"], "priority": s["priority"],
+                    "pending": 0, "submitted": 0, "rejected": 0,
+                    "generated": 0, "preempt_requeues": 0,
+                    "prefill_tokens": 0})
+                for k in ("pending", "submitted", "rejected",
+                          "generated", "preempt_requeues",
+                          "prefill_tokens"):
+                    cur[k] += s[k]
+        from cloud_server_tpu.inference.qos import compute_fair_shares
+        shares = compute_fair_shares(
+            {name: (s["weight"], float(s["generated"]))
+             for name, s in merged.items()})
+        for name, s in merged.items():
+            s["fair_share"] = shares[name]
+        return merged
 
     def flight_window(self, n: int | None = None) -> list[dict]:
         """Recent flight-recorder records across the fleet, each tagged

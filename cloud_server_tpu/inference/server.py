@@ -448,6 +448,11 @@ class Request:
     seed_used: int = 0
     # multi-LoRA serving: registered adapter name (paged server)
     adapter: str | None = None
+    # multi-tenant QoS (inference/qos.py): resolved tenant name, set at
+    # submit. None = QoS disabled (no registry configured); requests on
+    # a QoS-enabled server always carry a concrete name ("default" when
+    # the client sent none).
+    tenant: str | None = None
     tokens: list[int] = dataclasses.field(default_factory=list)
     # log P(token) under the model's raw (pre-filter) distribution,
     # aligned with `tokens`
@@ -613,7 +618,8 @@ class InferenceServer:
                  decode_chunk: int = 1, max_pending: int | None = None,
                  prefix_tokens: Sequence[int] | None = None,
                  prefix_remainder_cap: int = 1024,
-                 metrics: ServingMetrics | None = None):
+                 metrics: ServingMetrics | None = None,
+                 qos=None):
         # Serving never needs f32 master weights: pre-cast float32 leaves to
         # the compute dtype once, instead of streaming 2x the bytes and
         # converting on every decode step. QTensor leaves stay quantized
@@ -700,6 +706,14 @@ class InferenceServer:
         # backpressure: submit() past this bound raises QueueFullError
         # (HTTP 429); None = unbounded (library use, trusted callers)
         self.max_pending = max_pending
+        # multi-tenant QoS (inference/qos.py): `qos` may be a ready
+        # TenantRegistry, a config dict / JSON string / file path, or
+        # None (falls back to InferConfig.qos_config). None disables
+        # QoS: every guarded call site below short-circuits and the
+        # scheduler is byte-identical to the pre-QoS server. Imported
+        # lazily — qos.py imports QueueFullError from this module.
+        from cloud_server_tpu.inference.qos import resolve_registry
+        self.qos = resolve_registry(qos, infer_cfg.qos_config)
         self._draining = False
         self._slots: list[Request | None] = [None] * max_slots
         self._pending: collections.deque[Request] = collections.deque()
@@ -720,7 +734,8 @@ class InferenceServer:
     def submit(self, prompt: Sequence[int], *,
                max_new_tokens: int | None = None,
                stream: Callable[[int], None] | None = None,
-               sampling: SamplingParams | None = None) -> Request:
+               sampling: SamplingParams | None = None,
+               tenant: str | None = None) -> Request:
         if self._stop.is_set():
             # stop() was called or serve_forever died on a fatal error —
             # accepting now would enqueue work nothing will ever drain and
@@ -740,8 +755,15 @@ class InferenceServer:
             raise ValueError(
                 f"prompt of {len(prompt)} tokens leaves no room to decode "
                 f"within max_len={self.max_len}")
+        if self.qos is not None:
+            tenant = self.qos.resolve(tenant)
+        else:
+            # no registry = no frozen tenant set to bound cardinality:
+            # a caller-supplied string must not mint per-tenant labeled
+            # metric series (observe_emit labels by req.tenant)
+            tenant = None
         req = Request(prompt=list(prompt), max_new_tokens=max_new,
-                      stream=stream, sampling=sampling,
+                      stream=stream, sampling=sampling, tenant=tenant,
                       seed_used=resolve_seed(sampling, self._host_rng,
                                              self._lock),
                       submit_time=time.perf_counter())
@@ -758,6 +780,12 @@ class InferenceServer:
                 raise QueueFullError(
                     f"pending queue is full ({self.max_pending} "
                     "requests); retry later")
+            if self.qos is not None:
+                # per-tenant backpressure AFTER the global bound: a
+                # TenantQueueFullError here leaves no trace — the
+                # tenant's pending count only advances on success,
+                # atomically with the append below
+                self.qos.gate_submit(tenant, len(prompt))
             # telemetry BEFORE the append: once the request is in the
             # queue the scheduler thread may admit (even finish) it, and
             # the timeline must stay in lifecycle order
@@ -775,6 +803,8 @@ class InferenceServer:
                 self._pending.remove(req)
             except ValueError:
                 return  # active: the step sweep owns the teardown
+            if self.qos is not None:
+                self.qos.on_pending_removed(req.tenant)
         req.finish_reason = "cancelled"
         self._complete(req)
 
@@ -816,6 +846,8 @@ class InferenceServer:
         # those tokens were still generated (throughput accounting)
         if not (done and req.finish_reason == "eos"):
             self.tokens_emitted += 1
+            if self.qos is not None:
+                self.qos.charge_generated(req.tenant)
         if len(req.emit_times) > n0:  # a stop match truncates instead
             self.metrics.observe_emit(req)
         return done
@@ -841,7 +873,18 @@ class InferenceServer:
             free = [i for i, r in enumerate(self._slots) if r is None]
             group: list[tuple[int, Request]] = []
             while self._pending and len(group) < len(free):
-                req = self._pending.popleft()
+                if self.qos is not None:
+                    # deficit-round-robin over tenants (FIFO within a
+                    # tenant; degenerates to plain FIFO with a single
+                    # tenant) — the fair-share admission policy
+                    idx = self.qos.next_admission_index(self._pending)
+                    req = self._pending[idx]
+                    del self._pending[idx]
+                    self.qos.charge_admission(req.tenant,
+                                              len(req.prompt))
+                    self.qos.on_pending_removed(req.tenant)
+                else:
+                    req = self._pending.popleft()
                 slot = free[len(group)]
                 self._slots[slot] = req
                 group.append((slot, req))
@@ -1072,6 +1115,8 @@ class InferenceServer:
                 req.finish_reason = f"error: {exc!r}"
                 self._complete(req)
         for req in pending:
+            if self.qos is not None:
+                self.qos.on_pending_removed(req.tenant)
             req.finish_reason = f"error: {exc!r}"
             self._complete(req)
 
@@ -1095,6 +1140,8 @@ class InferenceServer:
         reg.counter("prefix_misses_total",
                     "Admissions that missed the cached prefix"
                     ).set_total(self.prefix_misses)
+        if self.qos is not None:
+            self.qos.mirror_metrics(reg)
 
     def metrics_snapshot(self) -> dict:
         """Mergeable snapshot of every registered metric (the /metrics

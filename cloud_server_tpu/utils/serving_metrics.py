@@ -34,6 +34,14 @@ from typing import Callable, Iterable, Sequence
 
 NAMESPACE = "cloud_server"
 
+# Per-tenant TTFT histogram family (multi-tenant QoS): one labeled
+# series per tenant, observed once per request at first token. Shared
+# between ServingMetrics.observe_emit (the observation) and
+# TenantRegistry.mirror_metrics (eager registration, so the family
+# exists — and the docs drift check sees it — before any traffic).
+TENANT_TTFT = ("tenant_ttft_seconds",
+               "Time from submit to first emitted token, per tenant")
+
 # Shared latency bucket ladder (seconds): sub-ms through minutes, the
 # span TTFT/ITL/queue-wait cover between a warm single-chip deployment
 # and a cold multi-minute drain. Fixed at registration so merge() across
@@ -48,6 +56,25 @@ def _full_name(name: str) -> str:
         f"{NAMESPACE}_{name}"
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping — label values may come
+    from untrusted client headers (tenant names)."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _label_suffix(labels: dict[str, str] | None) -> str:
+    """Prometheus label block for a series key ('' when unlabeled).
+    Sorted so the same label set always yields the same series key —
+    which is what lets `merge_snapshots` add labeled series across
+    replicas by plain string key."""
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
 class Counter:
     """Monotonic counter. `inc` is the hot-path op; `set_total` exists
     for mirroring an externally-maintained monotonic count (e.g. the
@@ -56,6 +83,7 @@ class Counter:
     def __init__(self, name: str, help_text: str):
         self.name = name
         self.help = help_text
+        self.labels: dict[str, str] | None = None
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -72,7 +100,10 @@ class Counter:
         return self._value
 
     def snapshot(self) -> dict:
-        return {"type": "counter", "help": self.help, "value": self._value}
+        out = {"type": "counter", "help": self.help, "value": self._value}
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        return out
 
 
 class Gauge:
@@ -81,6 +112,7 @@ class Gauge:
     def __init__(self, name: str, help_text: str):
         self.name = name
         self.help = help_text
+        self.labels: dict[str, str] | None = None
         self._value = 0.0
 
     def set(self, value: float) -> None:
@@ -91,7 +123,10 @@ class Gauge:
         return self._value
 
     def snapshot(self) -> dict:
-        return {"type": "gauge", "help": self.help, "value": self._value}
+        out = {"type": "gauge", "help": self.help, "value": self._value}
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        return out
 
 
 class Histogram:
@@ -110,6 +145,7 @@ class Histogram:
                              "non-empty sequence of upper bounds")
         self.name = name
         self.help = help_text
+        self.labels: dict[str, str] | None = None
         self.buckets = tuple(float(b) for b in buckets)
         self._counts = [0] * (len(self.buckets) + 1)  # +1: +Inf overflow
         self._sum = 0.0
@@ -133,10 +169,13 @@ class Histogram:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {"type": "histogram", "help": self.help,
-                    "buckets": list(self.buckets),
-                    "counts": list(self._counts),
-                    "sum": self._sum, "count": self._count}
+            out = {"type": "histogram", "help": self.help,
+                   "buckets": list(self.buckets),
+                   "counts": list(self._counts),
+                   "sum": self._sum, "count": self._count}
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        return out
 
 
 class MetricsRegistry:
@@ -151,28 +190,37 @@ class MetricsRegistry:
         self._collectors: list[Callable[[], None]] = []
         self._lock = threading.Lock()
 
-    def _get_or_create(self, cls, name: str, help_text: str, *args):
+    def _get_or_create(self, cls, name: str, help_text: str,
+                       labels: dict[str, str] | None, *args):
         name = _full_name(name)
+        key = name + _label_suffix(labels)
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is None:
                 m = cls(name, help_text, *args)
-                self._metrics[name] = m
+                if labels:
+                    m.labels = {str(k): str(v)
+                                for k, v in labels.items()}
+                self._metrics[key] = m
             elif not isinstance(m, cls):
                 raise ValueError(
-                    f"metric {name} already registered as "
+                    f"metric {key} already registered as "
                     f"{type(m).__name__}, not {cls.__name__}")
             return m
 
-    def counter(self, name: str, help_text: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help_text)
+    def counter(self, name: str, help_text: str = "",
+                labels: dict[str, str] | None = None) -> Counter:
+        return self._get_or_create(Counter, name, help_text, labels)
 
-    def gauge(self, name: str, help_text: str = "") -> Gauge:
-        return self._get_or_create(Gauge, name, help_text)
+    def gauge(self, name: str, help_text: str = "",
+              labels: dict[str, str] | None = None) -> Gauge:
+        return self._get_or_create(Gauge, name, help_text, labels)
 
     def histogram(self, name: str, help_text: str = "",
-                  buckets: Sequence[float] = LATENCY_BUCKETS) -> Histogram:
-        return self._get_or_create(Histogram, name, help_text, buckets)
+                  buckets: Sequence[float] = LATENCY_BUCKETS,
+                  labels: dict[str, str] | None = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help_text, labels,
+                                   buckets)
 
     def add_collector(self, fn: Callable[[], None]) -> None:
         with self._lock:
@@ -196,7 +244,8 @@ def merge_snapshots(snaps: Iterable[dict[str, dict]]) -> dict[str, dict]:
         for name, entry in snap.items():
             cur = out.get(name)
             if cur is None:
-                out[name] = {k: (list(v) if isinstance(v, list) else v)
+                out[name] = {k: (list(v) if isinstance(v, list) else
+                                 dict(v) if isinstance(v, dict) else v)
                              for k, v in entry.items()}
                 continue
             if cur["type"] != entry["type"]:
@@ -251,23 +300,41 @@ def histogram_summary(entry: dict) -> dict:
 
 def render_prometheus(snapshot: dict[str, dict]) -> str:
     """Prometheus text exposition (version 0.0.4) of a snapshot: every
-    series gets exactly one HELP and one TYPE line; histograms render
-    cumulative `_bucket{le=...}` series plus `_sum`/`_count`."""
+    metric FAMILY gets exactly one HELP and one TYPE line (labeled
+    series — snapshot keys like `name{tenant="a"}` — share their
+    family's metadata); histograms render cumulative `_bucket{le=...}`
+    series plus `_sum`/`_count`, with the series' own labels folded in
+    ahead of `le`."""
     out: list[str] = []
-    for name, entry in snapshot.items():
-        out.append(f"# HELP {name} {entry.get('help', '')}")
-        out.append(f"# TYPE {name} {entry['type']}")
+    seen_meta: set[str] = set()
+    # group by FAMILY, not raw key: the exposition format wants every
+    # series of a family contiguous under one HELP/TYPE, and a plain
+    # key sort can interleave (`foo_bar` sorts between `foo` and
+    # `foo{...}` because "_" < "{"). Sorting here also makes the output
+    # independent of snapshot dict ordering.
+    for name, entry in sorted(
+            snapshot.items(),
+            key=lambda kv: (kv[0].partition("{")[0], kv[0])):
+        base, _, label_rest = name.partition("{")
+        labels = "{" + label_rest if label_rest else ""
+        # labels without the closing brace, for composing with `le`
+        inner = label_rest[:-1] + "," if label_rest else ""
+        if base not in seen_meta:
+            out.append(f"# HELP {base} {entry.get('help', '')}")
+            out.append(f"# TYPE {base} {entry['type']}")
+            seen_meta.add(base)
         if entry["type"] == "histogram":
             cum = 0
             for edge, c in zip(entry["buckets"], entry["counts"]):
                 cum += c
-                out.append(f'{name}_bucket{{le="{edge:g}"}} {cum}')
+                out.append(
+                    f'{base}_bucket{{{inner}le="{edge:g}"}} {cum}')
             cum += entry["counts"][-1]
-            out.append(f'{name}_bucket{{le="+Inf"}} {cum}')
-            out.append(f"{name}_sum {entry['sum']}")
-            out.append(f"{name}_count {entry['count']}")
+            out.append(f'{base}_bucket{{{inner}le="+Inf"}} {cum}')
+            out.append(f"{base}_sum{labels} {entry['sum']}")
+            out.append(f"{base}_count{labels} {entry['count']}")
         else:
-            out.append(f"{name} {entry['value']}")
+            out.append(f"{base}{labels} {entry['value']}")
     return "\n".join(out) + "\n"
 
 
@@ -328,7 +395,15 @@ class ServingMetrics:
         if len(times) == 1:
             req.record_event("first_token", times[0])
             if req.submit_time is not None:
-                self.ttft.observe(times[0] - req.submit_time)
+                ttft = times[0] - req.submit_time
+                self.ttft.observe(ttft)
+                tenant = getattr(req, "tenant", None)
+                if tenant:
+                    # once per request (not per token): the per-tenant
+                    # latency view QoS isolation is judged by
+                    self.registry.histogram(
+                        *TENANT_TTFT,
+                        labels={"tenant": tenant}).observe(ttft)
         elif len(times) >= 2:
             self.itl.observe(times[-1] - times[-2])
 

@@ -22,6 +22,13 @@
 # plus the engine/server/checkpoint flows.
 #   ./run_tests.sh --all   # full sweep (~35 min)
 #   ./run_tests.sh <pytest args...>  # fast set with extra args
+#
+# Group membership is by filename glob, so new test files land
+# automatically: tests/test_qos.py (multi-tenant QoS) rides the [p-r]
+# group with the other serving-stack heavies, and tests/test_analysis.py
+# (the stdlib-only hot-path lint gate over inference/qos.py +
+# serving_metrics.py) rides [a-f]. The lint is also runnable standalone:
+#   python -m cloud_server_tpu.analysis
 MARK=(-m "not slow")
 if [ "$1" = "--all" ]; then
     MARK=(); shift

@@ -1,0 +1,30 @@
+"""Fixture: hot-path functions the lint must FLAG — one violation
+class per function, so the test can assert each rule fires."""
+
+import time
+
+
+class BadPolicy:
+    def device_work(self, x):
+        import jax.numpy as jnp
+        return jnp.asarray(x)
+
+    def numpy_alloc(self, xs):
+        import numpy as np
+        return np.asarray(xs)
+
+    def blocking_sync(self, x):
+        return x.item()
+
+    def host_io(self, x):
+        print(x)
+        return x
+
+    def wall_clock(self):
+        return time.time()
+
+    def sleeper(self):
+        time.sleep(0.1)
+
+    def fine_actually(self):
+        return time.perf_counter()

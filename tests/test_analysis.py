@@ -1,0 +1,65 @@
+"""The hot-path lint gate: per-iteration scheduler code (QoS admission
+policy, metric observe ops) must stay free of device work, blocking
+syncs, numpy-buffer allocation, wall-clock reads, and host I/O — and
+the checker itself must actually catch each violation class (fixture
+round-trip). Stdlib-only: this file never imports jax."""
+
+import pathlib
+
+from cloud_server_tpu.analysis import (HOT_PATHS, check_hot_paths,
+                                       check_source)
+
+_HERE = pathlib.Path(__file__).resolve().parent
+_FIXTURES = _HERE / "analysis_fixtures"
+
+
+def test_registered_hot_paths_are_clean():
+    findings = check_hot_paths(str(_HERE.parent))
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def test_registry_covers_qos_admission_policy():
+    """The per-iteration QoS entry points must stay registered — the
+    lint is the standing guarantee that fair-share admission never
+    reintroduces per-iteration syncs or device allocations."""
+    quals = set(HOT_PATHS["cloud_server_tpu/inference/qos.py"])
+    for needed in ("TenantRegistry.next_admission_index",
+                   "TenantRegistry.order_jobs",
+                   "TenantRegistry.charge_prefill",
+                   "TenantRegistry.charge_generated",
+                   "TenantRegistry.victim_rank",
+                   "TokenBucket.try_consume"):
+        assert needed in quals, f"{needed} dropped from HOT_PATHS"
+
+
+def test_checker_accepts_clean_fixture():
+    src = (_FIXTURES / "hot_path_good.py").read_text()
+    findings = check_source("hot_path_good.py", src,
+                            ("GoodBucket.refill", "GoodBucket.pick"))
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def test_checker_flags_each_violation_class():
+    src = (_FIXTURES / "hot_path_bad.py").read_text()
+    cases = {
+        "BadPolicy.device_work": "device",
+        "BadPolicy.numpy_alloc": "numpy",
+        "BadPolicy.blocking_sync": "sync",
+        "BadPolicy.host_io": "I/O",
+        "BadPolicy.wall_clock": "time.time",
+        "BadPolicy.sleeper": "sleep",
+    }
+    for qual, needle in cases.items():
+        findings = check_source("hot_path_bad.py", src, (qual,))
+        assert findings, f"{qual}: expected a finding"
+        assert any(needle in f.message for f in findings), \
+            f"{qual}: {[str(f) for f in findings]}"
+    # the allowed monotonic clock must NOT fire
+    assert not check_source("hot_path_bad.py", src,
+                            ("BadPolicy.fine_actually",))
+
+
+def test_checker_flags_missing_registration():
+    findings = check_source("x.py", "def f():\n    pass\n",
+                            ("DoesNotExist.method",))
+    assert findings and "not found" in findings[0].message

@@ -11,6 +11,7 @@ import pytest
 from cloud_server_tpu.config import ModelConfig
 from cloud_server_tpu.models import transformer
 from cloud_server_tpu.ops.fused_ce import fused_ce_stats
+from jax_compat import requires_jax08_shard_map
 
 CFG = ModelConfig(
     vocab_size=512, embed_dim=64, num_layers=2, num_heads=4,
@@ -133,6 +134,7 @@ def test_moe_loss_honors_pallas_ce():
     np.testing.assert_allclose(float(lp), float(ld), rtol=1e-5)
 
 
+@requires_jax08_shard_map
 def test_pipeline_loss_honors_pallas_ce():
     from cloud_server_tpu.config import MeshConfig
     from cloud_server_tpu.parallel.mesh import make_mesh

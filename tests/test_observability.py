@@ -113,6 +113,32 @@ def test_render_prometheus_wellformed():
     assert "cloud_server_c_seconds_count 2" in lines
 
 
+def test_render_prometheus_groups_families_contiguously():
+    """The exposition format wants every series of a family in one
+    group. A raw key sort interleaves (`foo_bar` sorts between `foo`
+    and `foo{...}` because "_" < "{"), so the renderer must group by
+    FAMILY — and do so regardless of snapshot dict ordering."""
+    snap = {  # adversarial order AND adversarial names
+        'cloud_server_foo{tenant="a"}':
+            {"type": "gauge", "help": "F", "value": 1.0},
+        "cloud_server_foo_bar":
+            {"type": "gauge", "help": "FB", "value": 2.0},
+        "cloud_server_foo":
+            {"type": "gauge", "help": "F", "value": 3.0},
+    }
+    text = render_prometheus(snap)
+    _assert_exposition_wellformed(text)
+    fams = [ln.split("{")[0].rsplit(" ", 1)[0].strip()
+            for ln in text.splitlines()
+            if ln and not ln.startswith("#")]
+    prev, seen = None, set()
+    for f in fams:
+        if f != prev:
+            assert f not in seen, f"family {f} split by another family"
+            seen.add(f)
+            prev = f
+
+
 def _assert_exposition_wellformed(text: str) -> None:
     """Every series has exactly one HELP and one TYPE line and no
     sample name repeats (histogram buckets aside, which must be
@@ -460,15 +486,21 @@ def test_debug_trace_endpoint(frontend, tmp_path):
 def test_metric_catalog_matches_docs(params):
     """Every metric name registered at runtime appears in
     docs/observability.md's catalog tables, and vice versa — the
-    catalog cannot rot in either direction."""
+    catalog cannot rot in either direction. Tenant-labeled series
+    (multi-tenant QoS) are cataloged by their FAMILY name, so the
+    label suffix is stripped before comparing; one paged server runs
+    with a QoS config so the per-tenant families register."""
     doc = (pathlib.Path(__file__).resolve().parents[1]
            / "docs" / "observability.md").read_text()
     catalog = set(re.findall(r"^\|\s*`(cloud_server_[a-z0-9_]+)`", doc,
                              re.M))
     contig = InferenceServer(params, CFG, GREEDY, max_slots=1,
                              max_len=64, prompt_buckets=[16])
-    paged = PagedInferenceServer(params, CFG, GREEDY, **PAGED_KW)
-    runtime = set(contig.metrics_snapshot()) | set(paged.metrics_snapshot())
+    paged = PagedInferenceServer(params, CFG, GREEDY,
+                                 qos={"tenants": {"a": {}}}, **PAGED_KW)
+    runtime = {name.split("{")[0] for name in
+               set(contig.metrics_snapshot())
+               | set(paged.metrics_snapshot())}
     missing_from_docs = runtime - catalog
     stale_in_docs = catalog - runtime
     assert not missing_from_docs, (
