@@ -405,6 +405,50 @@ def _admission_churn_bench(params, base, infer_cfg):
     return out
 
 
+def _check_span_trees(srv, reqs):
+    """Trace-side integrity check (the span analogue of the
+    churn_srv_* histogram agreement): a fully-sampled run produced
+    exactly ONE span tree per request; each tree's phase spans are
+    monotonic and GAP-FREE (every phase starts exactly where the
+    previous ended, covering submit -> finish); and the span
+    boundaries agree with the request's own externally recorded
+    timing (root start == submit_time, first prefill ends at the
+    first emit)."""
+    from cloud_server_tpu.inference.request_trace import PHASES
+    trees = srv.trace_trees()
+    assert len(trees) == len(reqs), (
+        f"{len(trees)} span trees for {len(reqs)} requests")
+    by_id = {t["request_id"]: t for t in trees}
+    assert len(by_id) == len(reqs), "duplicate trees for one request"
+    for r in reqs:
+        root = by_id[r.request_id]["root"]
+        assert root["start"] == r.submit_time
+        assert root["end"] is not None, "unfinished tree after idle"
+        phases = [c for c in root["children"] if c["name"] in PHASES]
+        names = [p["name"] for p in phases]
+        for want in ("queue", "prefill", "decode", "emit"):
+            assert want in names, f"missing {want} in {names}"
+        assert phases[0]["start"] == root["start"]
+        for a, b in zip(phases, phases[1:]):
+            assert a["end"] == b["start"], \
+                f"gap between {a['name']} and {b['name']}"
+        assert phases[-1]["end"] == root["end"]
+        if r.emit_times:
+            first_prefill = next(p for p in phases
+                                 if p["name"] == "prefill")
+            assert first_prefill["end"] == r.emit_times[0]
+
+
+# Churn-section SLO config (no QoS registry -> every request rides the
+# "default" class): generous targets so attainment reads the
+# scheduler, not the tunnel's fixed dispatch cost.
+_CHURN_SLO_CFG = {
+    "windows_s": [60, 300],
+    "classes": {"default": {"objective": 0.99, "ttft_s": 2.0,
+                            "itl_s": 1.0, "queue_wait_s": 2.0,
+                            "e2e_s": 300.0}}}
+
+
 def _churn_scenario(params, base, infer_cfg, scheduler):
     import dataclasses
 
@@ -417,11 +461,16 @@ def _churn_scenario(params, base, infer_cfg, scheduler):
     def scenario():
         # max_slots leaves headroom beyond the initial decode batch so a
         # wave admission lands MID-DECODE (the thing TTFT measures here)
-        # instead of queueing for a free slot
+        # instead of queueing for a free slot. Tracing at FULL sampling
+        # + SLO tracking ride along: the bench is also the standing
+        # proof that both layers cost nothing measurable (the
+        # dispatch-count regression test pins the zero-dispatch
+        # invariant; the A/B here would show any host-side drag).
         srv = PagedInferenceServer(
             params, cfg, infer_cfg, max_slots=16, max_context=1024,
             page_size=128, prefill_chunk=256, decode_chunk=8,
-            prompt_buckets=[64, 256, 512], scheduler=scheduler)
+            prompt_buckets=[64, 256, 512], scheduler=scheduler,
+            tracing=1.0, slo=_CHURN_SLO_CFG)
         rng = np.random.RandomState(0)
 
         def mk_prompt(n):
@@ -455,13 +504,17 @@ def _churn_scenario(params, base, infer_cfg, scheduler):
         dt = time.perf_counter() - t0
         snap = srv.metrics_snapshot()  # server-side telemetry, pre-stop
         flight = srv.flight_window()
+        # one span tree per request, gap-free phases, agrees with the
+        # request objects' own timing (full-sampling integrity check)
+        _check_span_trees(srv, first + waves)
+        slo_rep = srv.slo_report()
         srv.stop()
         return first, waves, dt, interleaved, dec_tok_adm, t_adm, \
-            snap, flight
+            snap, flight, slo_rep
 
     scenario()  # warm-up: every prefill/decode shape compiles here
     (first, waves, dt, interleaved, dec_tok_adm, t_adm,
-     snap, flight) = scenario()
+     snap, flight, slo_rep) = scenario()
 
     total = sum(len(r.tokens) for r in first + waves)
 
@@ -496,7 +549,17 @@ def _churn_scenario(params, base, infer_cfg, scheduler):
         f"external {ext_mean * 1e3:.1f} ms")
     util = [rec["budget_utilization"] for rec in flight
             if "budget_utilization" in rec]
-    return {"churn_tok_s": total / dt,
+    # SLO view of the same run (lifetime counts — deterministic, no
+    # window-edge sensitivity): default-class attainment per metric
+    slo_keys = {}
+    for metric in ("ttft", "itl"):
+        life = (slo_rep["classes"]["default"]["metrics"][metric]
+                ["lifetime"])
+        att = life["attainment"]
+        slo_keys[f"churn_slo_attainment_{metric}"] = (
+            1.0 if att is None else att)
+    return {**slo_keys,
+            "churn_tok_s": total / dt,
             "churn_decode_steps_during_admission": interleaved,
             "churn_decode_tok_s_during_admission":
                 dec_tok_adm / max(t_adm, 1e-9),
@@ -519,9 +582,12 @@ def _churn_scenario(params, base, infer_cfg, scheduler):
 def _qos_isolation_bench(params, base, infer_cfg):
     """Multi-tenant QoS isolation under overload, A/B over the
     aggressor: a steady "inter" tenant (interactive, weight 3) decodes
-    while a "scraper" tenant (best_effort, weight 1) floods the queue
+    while a "scraper" tenant (batch class, weight 1) floods the queue
     past slot capacity on a page pool sized to force preemption.
-    Three runs on the QoS-enabled server geometry:
+    Three runs on the QoS-enabled server geometry, each also tracked
+    against per-class SLO targets (`slo_attainment_interactive` /
+    `slo_attainment_batch` report the flood run's lifetime TTFT
+    attainment per class — the isolation story in SLO terms):
 
       * aggressor OFF  -> the victim's uncontended tok/s + ITL p99;
       * aggressor ON, QoS ON  -> fair-share admission + priority
@@ -540,11 +606,20 @@ def _qos_isolation_bench(params, base, infer_cfg):
     from cloud_server_tpu.inference.paged_server import PagedInferenceServer
 
     cfg = dataclasses.replace(base, decode_attention_impl="pallas")
+    # "batch" (not best_effort) for the aggressor: victim selection is
+    # unchanged — preemption still targets the lowest class first —
+    # and the run now exercises BOTH SLO classes the per-class
+    # attainment keys report on (slo_attainment_{interactive,batch})
     qos_cfg = {"quantum": 64,
                "tenants": {
                    "inter": {"weight": 3.0, "priority": "interactive"},
-                   "scraper": {"weight": 1.0,
-                               "priority": "best_effort"}}}
+                   "scraper": {"weight": 1.0, "priority": "batch"}}}
+    slo_cfg = {"windows_s": [60, 300],
+               "classes": {
+                   "interactive": {"objective": 0.99, "ttft_s": 2.0,
+                                   "itl_s": 1.0, "e2e_s": 300.0},
+                   "batch": {"objective": 0.9, "ttft_s": 10.0,
+                             "e2e_s": 600.0}}}
 
     def scenario(aggressor: bool, qos):
         # 16 slots x 8 pages/slot worst case = 128; 72 pages forces
@@ -553,7 +628,8 @@ def _qos_isolation_bench(params, base, infer_cfg):
         srv = PagedInferenceServer(
             params, cfg, infer_cfg, max_slots=16, max_context=1024,
             page_size=128, prefill_chunk=256, decode_chunk=8,
-            prompt_buckets=[64, 256], num_pages=72, qos=qos)
+            prompt_buckets=[64, 256], num_pages=72, qos=qos,
+            slo=slo_cfg)
         rng = np.random.RandomState(0)
 
         def mk_prompt(n):
@@ -582,12 +658,23 @@ def _qos_isolation_bench(params, base, infer_cfg):
         itls.sort()
         p99 = itls[min(len(itls) - 1, int(0.99 * len(itls)))] if itls \
             else 0.0
+        # per-class TTFT attainment (lifetime counts: deterministic)
+        # BEFORE the cancel sweep pollutes e2e with cancellations
+        rep = srv.slo_report()
+
+        def attain(cls):
+            m = rep["classes"].get(cls, {}).get("metrics", {})
+            att = m.get("ttft", {}).get("lifetime", {}).get("attainment")
+            return 1.0 if att is None else att
+
         for r in victims + aggr:
             r.cancel()
         srv.run_until_idle()
         srv.stop()
         return {"victim_tok_s": v_tok_s, "aggressor_tok_s": a_tok_s,
-                "victim_itl_ms_p99": p99 * 1e3}
+                "victim_itl_ms_p99": p99 * 1e3,
+                "slo_attainment_interactive": attain("interactive"),
+                "slo_attainment_batch": attain("batch")}
 
     out = {}
     # qos=False force-disables (None would fall back to any
@@ -601,6 +688,11 @@ def _qos_isolation_bench(params, base, infer_cfg):
         out[f"qos_{tag}_itl_ms_p99"] = res["victim_itl_ms_p99"]
         if aggressor:
             out[f"qos_{tag}_aggressor_tok_s"] = res["aggressor_tok_s"]
+        if tag == "flood":  # the QoS-on overload run: the per-class
+            # SLO view of isolation (lifetime TTFT attainment)
+            out["slo_attainment_interactive"] = \
+                res["slo_attainment_interactive"]
+            out["slo_attainment_batch"] = res["slo_attainment_batch"]
         print(f"[serving_bench] qos_{tag}: victim "
               f"{res['victim_tok_s']:.1f} tok/s, itl p99 "
               f"{res['victim_itl_ms_p99']:.1f} ms, aggressor "

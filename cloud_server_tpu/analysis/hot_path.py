@@ -43,6 +43,24 @@ import os
 # called from step()/submit() on every request or iteration belongs
 # here.
 HOT_PATHS: dict[str, tuple[str, ...]] = {
+    # per-request tracing: the span-RECORD path runs at submit, at
+    # request completion, and once per traced iteration — tree
+    # building and exports are read-path only and deliberately absent
+    "cloud_server_tpu/inference/request_trace.py": (
+        "RequestTrace.add_span",
+        "RequestTrace.annotate",
+        "TraceRecorder.should_sample",
+        "TraceRecorder.begin",
+        "TraceRecorder.finish",
+    ),
+    # SLO tracking: observe() runs at admit / first-token / emit /
+    # finish host moments; report/mirror are scrape-path only
+    "cloud_server_tpu/inference/slo.py": (
+        "ClassSLO.target",
+        "_RollingCounts.observe",
+        "SLOTracker.resolve_class",
+        "SLOTracker.observe",
+    ),
     "cloud_server_tpu/inference/qos.py": (
         "TokenBucket._refill",
         "TokenBucket.level",
@@ -51,6 +69,7 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "TokenBucket.retry_after",
         "TenantRegistry.resolve",
         "TenantRegistry.priority_rank",
+        "TenantRegistry.priority_class",
         "TenantRegistry.weight",
         "TenantRegistry.victim_rank",
         "TenantRegistry._decay_recent",

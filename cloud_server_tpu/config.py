@@ -211,12 +211,34 @@ class InferConfig:
     # arguments; servers parse it at construction. Constructor argument
     # `qos=` overrides.
     qos_config: str = ""
+    # Per-request distributed tracing (inference/request_trace.py):
+    # head-based sampling probability in [0, 1]. 0.0 (the default)
+    # disables tracing entirely — the schedulers run the byte-identical
+    # pre-trace paths. Sampled requests carry a span tree (queue /
+    # prefill / decode / preempt_gap / emit phases plus per-iteration
+    # scheduler spans) retrievable via GET /debug/requests/<id> and
+    # exported Chrome-trace-style via GET /traces; W3C `traceparent`
+    # headers propagate in and out. Constructor argument `tracing=`
+    # (a rate or a ready TraceRecorder) overrides.
+    trace_sample_rate: float = 0.0
+    # Per-class SLO targets (inference/slo.py): a JSON object as a
+    # string, or a path to a JSON file, declaring per-priority-class
+    # latency targets (ttft/itl/queue_wait/e2e) and attainment
+    # objectives plus the rolling windows (schema in the module
+    # docstring; surfaced via GET /slo and the slo_attainment /
+    # slo_burn_rate gauges). "" (the default) disables SLO tracking
+    # entirely. A string keeps this dataclass hashable for jit static
+    # arguments; servers parse it at construction. Constructor
+    # argument `slo=` overrides.
+    slo_config: str = ""
 
     def __post_init__(self) -> None:
         if self.scheduler not in ("mixed", "alternating"):
             raise ValueError(f"unknown scheduler: {self.scheduler!r}")
         if self.flight_recorder_size <= 0:
             raise ValueError("flight_recorder_size must be positive")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError("trace_sample_rate must be in [0, 1]")
 
 
 def to_json(cfg: Any) -> str:

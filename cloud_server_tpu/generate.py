@@ -158,6 +158,21 @@ def build_parser() -> argparse.ArgumentParser:
                    "preemption, and per-tenant 429s; omitted, the "
                    "server runs the byte-identical single-tenant FIFO "
                    "paths")
+    p.add_argument("--slo-config", metavar="FILE_OR_JSON", default=None,
+                   help="per-priority-class SLO targets: a JSON file "
+                   "path (or inline JSON object) declaring per-class "
+                   "latency targets (ttft/itl/queue_wait/e2e), "
+                   "attainment objectives, and rolling windows (schema: "
+                   "inference/slo.py). Surfaced via GET /slo and the "
+                   "slo_attainment/slo_burn_rate gauges; omitted, SLO "
+                   "tracking is disabled entirely")
+    p.add_argument("--trace-sample-rate", type=float, default=0.0,
+                   metavar="RATE",
+                   help="per-request distributed tracing: head-based "
+                   "sampling probability in [0, 1]. Sampled requests "
+                   "carry span trees (GET /debug/requests/<id>, "
+                   "Perfetto export via GET /traces, W3C traceparent "
+                   "in/out). 0 (default) disables tracing entirely")
     p.add_argument("--ngram-draft", action="store_true",
                    help="speculative decoding WITHOUT a draft model: "
                    "propose continuations of repeated n-grams from the "
@@ -342,7 +357,9 @@ def main(argv=None) -> None:
                 max_len=max_len, seed=args.seed,
                 decode_chunk=args.decode_chunk,
                 prefix_tokens=prefix_toks,
-                qos=args.qos_config)
+                qos=args.qos_config,
+                slo=args.slo_config,
+                tracing=args.trace_sample_rate or None)
         if args.prefix:
             print("[generate] note: the paged server reuses shared "
                   "prefixes automatically (radix page cache); --prefix "
@@ -371,6 +388,8 @@ def main(argv=None) -> None:
             flight_recorder_size=args.flight_recorder or None,
             draft_params=draft_params, draft_cfg=draft_cfg,
             qos=args.qos_config,
+            slo=args.slo_config,
+            tracing=args.trace_sample_rate or None,
             tokenizer=tok)  # regex-constrained requests compile vs it
 
     if args.serve_http is not None:

@@ -41,7 +41,24 @@ Endpoints:
                     states for input (string / token list / list of
                     either), OpenAI response shape.
   GET  /v1/models   {"object": "list", "data": [{"id": ...}]}
-  GET  /healthz     {"ok": true, "active": N, "pending": N}
+  GET  /healthz     {"ok": true, "ready": bool, "active": N,
+                    "pending": N} — "ok" is liveness; "ready" flips
+                    false while the backend is draining (or stopped),
+                    so load balancers stop routing here while
+                    in-flight work finishes.
+  GET  /slo         Per-priority-class SLO attainment + burn rates
+                    over the configured rolling windows
+                    (inference/slo.py; {"enabled": false} without an
+                    SLO config). Behind a ReplicatedRouter the counts
+                    merge fleet-wide.
+  GET  /debug/requests/<id>  Span tree of one sampled request
+                    (inference/request_trace.py): queue / prefill /
+                    decode / preempt_gap / emit phases plus
+                    iteration-granular scheduler spans cross-linked
+                    to the flight recorder. 404 for unknown,
+                    unsampled, or evicted ids.
+  GET  /traces      Chrome-trace/Perfetto export of the sampled trace
+                    ring (?n=K bounds to the newest K trees).
   GET  /metrics     Full Prometheus text exposition from the backend's
                     metrics registry: request-lifecycle histograms
                     (TTFT / inter-token / queue-wait / e2e, with
@@ -89,11 +106,20 @@ gains a `tenants` section (per-tenant counters + fair-share view) and
 `/metrics` the tenant-labeled series cataloged in
 docs/observability.md.
 
+Distributed tracing (inference/request_trace.py): when the backend
+carries a TraceRecorder, an incoming W3C `traceparent` header joins
+the client's trace (its sampled flag is authoritative); responses
+that submitted work echo a `traceparent` naming this request's trace
+so callers can fetch `/debug/requests/<id>` or stitch downstream
+spans. Without a recorder the headers are ignored entirely.
+
 Access logging is OPT-IN (`HttpFrontend(..., access_log=...)`): one
 structured JSON line per request (method, path, status, duration,
-request id) through utils.logging.JsonLogger; stdlib http.server
-plumbing messages route into the same log. Disabled (the default)
-nothing is printed — the old unconditional silence, now a choice.
+request id — plus `tenant` and `trace_id` when resolved, correlating
+the access log with traces) through utils.logging.JsonLogger; stdlib
+http.server plumbing messages route into the same log. Disabled (the
+default) nothing is printed — the old unconditional silence, now a
+choice.
 
 Demo (server side: `python -m cloud_server_tpu.generate --serve-http
 8000 ...` or `HttpFrontend(srv, tok).start()`):
@@ -119,6 +145,9 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from cloud_server_tpu.inference.request_trace import (
+    TRACEPARENT_HEADER, chrome_trace, format_traceparent,
+    parse_traceparent)
 from cloud_server_tpu.inference.sampling import SamplingParams
 from cloud_server_tpu.inference.server import QueueFullError
 from cloud_server_tpu.utils.logging import JsonLogger
@@ -247,6 +276,14 @@ def _finish(reason: str | None) -> str:
     return "length" if reason == "length" else "stop"
 
 
+def _query_int(url, name: str, default: int | None) -> int | None:
+    """Integer query parameter (?n=K), `default` when absent; raises
+    ValueError on junk (callers map it to a 400). THE one parser for
+    the windowed GET endpoints (/stats, /traces)."""
+    raw = parse_qs(url.query).get(name)
+    return default if not raw else int(raw[0])
+
+
 class HttpFrontend:
     """Bind a serving backend (+ optional tokenizer) to an HTTP port.
 
@@ -292,18 +329,31 @@ class HttpFrontend:
             def _access(self, method: str, t0: float) -> None:
                 if front.access_log is None:
                     return
-                front.access_log.log({
+                record = {
                     "event": "access", "method": method,
                     "path": self.path,
                     "status": getattr(self, "_status", None),
                     "duration_ms": round(
                         (time.perf_counter() - t0) * 1e3, 3),
-                    "request_id": getattr(self, "_rid", None)})
+                    "request_id": getattr(self, "_rid", None)}
+                # trace/tenant correlation: present only when resolved
+                # for this request, so untraced deployments' log shape
+                # is unchanged
+                tenant = getattr(self, "_tenant", None)
+                if tenant:
+                    record["tenant"] = tenant
+                trace_id = getattr(self, "_trace_id", None)
+                if trace_id:
+                    record["trace_id"] = trace_id
+                front.access_log.log(record)
 
             def _begin(self) -> float:
                 self._rid = (self.headers.get("X-Request-Id")
                              or uuid.uuid4().hex[:12])
                 self._status = None
+                self._tenant = None
+                self._trace_ctx = None
+                self._trace_id = None
                 return time.perf_counter()
 
             def _json(self, code: int, payload: dict,
@@ -327,9 +377,43 @@ class HttpFrontend:
             def _do_get(self):
                 url = urlparse(self.path)
                 if url.path == "/healthz":
+                    # ok = liveness; ready = routability (false while
+                    # the backend drains or after stop(), so load
+                    # balancers shed this replica without killing its
+                    # in-flight work)
                     self._json(200, {"ok": True,
+                                     "ready": bool(getattr(
+                                         front.srv, "ready", True)),
                                      "active": front.srv.num_active,
                                      "pending": front.srv.num_pending})
+                elif url.path == "/slo":
+                    fn = getattr(front.srv, "slo_report", None)
+                    rep = fn() if fn is not None else None
+                    self._json(200, rep if rep is not None
+                               else {"enabled": False})
+                elif url.path == "/traces":
+                    fn = getattr(front.srv, "trace_trees", None)
+                    if fn is None:
+                        self._json(404, {"error": "this serving backend "
+                                         "does not support tracing"})
+                        return
+                    try:
+                        n = _query_int(url, "n", None)
+                    except ValueError:
+                        self._json(400, {"error": '"n" must be an int'})
+                        return
+                    self._json(200, chrome_trace(fn(n)))
+                elif url.path.startswith("/debug/requests/"):
+                    rid = url.path[len("/debug/requests/"):]
+                    fn = getattr(front.srv, "lookup_trace", None)
+                    tree = fn(rid) if fn is not None and rid else None
+                    if tree is None:
+                        self._json(404, {
+                            "error": "unknown, unsampled, or evicted "
+                            "request id (tracing must be enabled and "
+                            "the request sampled)"})
+                    else:
+                        self._json(200, tree)
                 elif url.path == "/metrics":
                     body = front._metrics_text().encode()
                     self.send_response(200)
@@ -340,7 +424,7 @@ class HttpFrontend:
                     self.wfile.write(body)
                 elif url.path == "/stats":
                     try:
-                        n = int(parse_qs(url.query).get("n", ["64"])[0])
+                        n = _query_int(url, "n", 64)
                     except ValueError:
                         self._json(400, {"error": '"n" must be an int'})
                         return
@@ -386,6 +470,13 @@ class HttpFrontend:
                 # (X-Tenant, or an API key the registry maps), resolved
                 # once per request and threaded into every submit
                 self._tenant = front._resolve_tenant(self.headers)
+                # distributed tracing: a W3C traceparent joins the
+                # caller's trace (parsed once; malformed headers
+                # degrade to a fresh trace, never an error)
+                self._trace_ctx = parse_traceparent(
+                    self.headers.get(TRACEPARENT_HEADER))
+                if self._trace_ctx is not None:
+                    self._trace_id = self._trace_ctx[0]
                 try:
                     body = self._body()
                 except (ValueError, json.JSONDecodeError) as exc:
@@ -545,6 +636,28 @@ class HttpFrontend:
         t = getattr(handler, "_tenant", None)
         return {"tenant": t} if t else {}
 
+    @staticmethod
+    def _trace_kw(handler) -> dict:
+        """submit() kwargs carrying the parsed incoming traceparent —
+        empty when the client sent none (same third-party-backend rule
+        as _tenant_kw; local head sampling still applies either way)."""
+        ctx = getattr(handler, "_trace_ctx", None)
+        return {"trace_ctx": ctx} if ctx is not None else {}
+
+    @staticmethod
+    def _trace_headers(handler, request) -> dict:
+        """Response headers for a submitted request: a W3C
+        `traceparent` naming its trace (so the caller can stitch
+        downstream spans or fetch /debug/requests/<id>), empty when
+        the request was not sampled. Also notes the trace id for the
+        access log."""
+        tr = getattr(request, "trace", None)
+        if tr is None:
+            return {}
+        handler._trace_id = tr.trace_id
+        return {TRACEPARENT_HEADER: format_traceparent(
+            tr.trace_id, tr.root_span_id)}
+
     def _adapter_kw(self, body: dict) -> dict:
         """OpenAI routing: a `model` naming a registered LoRA adapter
         selects it (vLLM convention); the base model id or an unknown
@@ -590,12 +703,15 @@ class HttpFrontend:
                     "this serving backend does not support adapters")
             kw["adapter"] = body["adapter"]
         kw.update(self._tenant_kw(handler))
+        kw.update(self._trace_kw(handler))
         request, q = self._submit_streaming(tokens, max_new, sampling,
                                             **kw)
 
         handler.send_response(200)
         handler.send_header("Content-Type", "application/x-ndjson")
         handler.send_header("Connection", "close")
+        for k, v in self._trace_headers(handler, request).items():
+            handler.send_header(k, v)
         handler.end_headers()
         emitted = 0
         try:
@@ -687,11 +803,13 @@ class HttpFrontend:
                                  "token-id lists")
         return out
 
-    def _sse_head(self, handler) -> None:
+    def _sse_head(self, handler, headers: dict | None = None) -> None:
         handler.send_response(200)
         handler.send_header("Content-Type", "text/event-stream")
         handler.send_header("Cache-Control", "no-cache")
         handler.send_header("Connection", "close")
+        for k, v in (headers or {}).items():
+            handler.send_header(k, v)
         handler.end_headers()
 
     @staticmethod
@@ -724,8 +842,10 @@ class HttpFrontend:
                                  "n=1")
             request, q = self._submit_streaming(
                 prompts[0], max_new, sampling,
-                **self._adapter_kw(body), **self._tenant_kw(handler))
-            self._sse_head(handler)
+                **self._adapter_kw(body), **self._tenant_kw(handler),
+                **self._trace_kw(handler))
+            self._sse_head(handler,
+                           self._trace_headers(handler, request))
             stream = _TextStream(self.tokenizer)
             try:
                 for tok in self._drain(q):
@@ -756,7 +876,8 @@ class HttpFrontend:
                     sampling, seed=(sampling.seed + k) % (2 ** 32))
             return sampling
 
-        akw = {**self._adapter_kw(body), **self._tenant_kw(handler)}
+        akw = {**self._adapter_kw(body), **self._tenant_kw(handler),
+               **self._trace_kw(handler)}
         cands, submitted = [], []
         try:
             for p in prompts:
@@ -818,7 +939,8 @@ class HttpFrontend:
             **base, "choices": choices,
             "usage": {"prompt_tokens": usage_p,
                       "completion_tokens": usage_c,
-                      "total_tokens": usage_p + usage_c}})
+                      "total_tokens": usage_p + usage_c}},
+            headers=self._trace_headers(handler, submitted[0]))
 
     def _handle_embeddings(self, handler, body: dict) -> None:
         """OpenAI /v1/embeddings: input is a string, a token list, or a
@@ -878,8 +1000,10 @@ class HttpFrontend:
         if body.get("stream"):
             request, q = self._submit_streaming(
                 prompt, max_new, sampling,
-                **self._adapter_kw(body), **self._tenant_kw(handler))
-            self._sse_head(handler)
+                **self._adapter_kw(body), **self._tenant_kw(handler),
+                **self._trace_kw(handler))
+            self._sse_head(handler,
+                           self._trace_headers(handler, request))
             stream = _TextStream(self.tokenizer)
             try:
                 self._sse(handler, {
@@ -911,7 +1035,8 @@ class HttpFrontend:
         req = self.srv.submit(prompt, max_new_tokens=max_new,
                               sampling=sampling,
                               **self._adapter_kw(body),
-                              **self._tenant_kw(handler))
+                              **self._tenant_kw(handler),
+                              **self._trace_kw(handler))
         toks = req.result()
         handler._json(200, {
             **base, "object": "chat.completion",
@@ -922,7 +1047,8 @@ class HttpFrontend:
                 "finish_reason": _finish(req.finish_reason)}],
             "usage": {"prompt_tokens": len(prompt),
                       "completion_tokens": len(toks),
-                      "total_tokens": len(prompt) + len(toks)}})
+                      "total_tokens": len(prompt) + len(toks)}},
+            headers=self._trace_headers(handler, req))
 
     @property
     def address(self) -> tuple[str, int]:

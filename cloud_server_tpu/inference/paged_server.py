@@ -803,7 +803,7 @@ class PagedInferenceServer:
                  mixed_token_budget: int | None = None,
                  metrics: ServingMetrics | None = None,
                  flight_recorder_size: int | None = None,
-                 qos=None):
+                 qos=None, tracing=None, slo=None):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -1000,6 +1000,26 @@ class PagedInferenceServer:
                    else infer_cfg.flight_recorder_size)
         self.flight = FlightRecorder(fr_size)
         self._iter_stats: dict = {}
+        # per-request distributed tracing + per-class SLO tracking
+        # (inference/request_trace.py, inference/slo.py): both None
+        # unless configured — every guarded call site short-circuits,
+        # so the scheduler is byte-identical to the pre-trace build.
+        # All span timestamps reuse the iteration t0/now pair the
+        # flight recorder already reads: zero extra dispatches/syncs
+        # (the dispatch-count regression test covers a tracing+SLO
+        # clone at 100% sampling).
+        from cloud_server_tpu.inference.request_trace import (
+            resolve_recorder)
+        from cloud_server_tpu.inference.slo import resolve_slo
+        self.trace_recorder = resolve_recorder(
+            tracing, infer_cfg.trace_sample_rate)
+        self.slo = resolve_slo(slo, infer_cfg.slo_config)
+        if self.slo is not None:
+            self.metrics.slo = self.slo
+        # iteration-granular spans staged by the dispatch paths and
+        # stamped with the shared (t0, now, iteration) frame by
+        # _record_iteration — one list append per traced participant
+        self._iter_spans: list = []
 
         self._slots: list[_Slot | None] = [None] * max_slots
         self._jobs: list[_AdmitJob] = []
@@ -1078,7 +1098,8 @@ class PagedInferenceServer:
                max_new_tokens: int | None = None, stream=None,
                sampling: SamplingParams | None = None,
                adapter: str | None = None,
-               tenant: str | None = None) -> Request:
+               tenant: str | None = None,
+               trace_ctx: tuple | None = None) -> Request:
         if self._stop.is_set():
             raise RuntimeError("server is stopped; not accepting requests")
         if (adapter is not None
@@ -1117,6 +1138,11 @@ class PagedInferenceServer:
                       seed_used=resolve_seed(sampling, self._host_rng,
                                              self._lock),
                       submit_time=time.perf_counter())
+        if self.slo is not None:
+            # class mapping: the tenant's QoS priority class; plain
+            # "default" without a registry
+            req.slo_class = (self.qos.priority_class(tenant)
+                             if self.qos is not None else None)
         req._on_cancel = self._handle_cancel  # before it can be seen
         with self._lock:
             # under the lock: drain() flips _draining under the same
@@ -1140,7 +1166,16 @@ class PagedInferenceServer:
                 self.qos.gate_submit(tenant, len(prompt))
             # telemetry BEFORE the append: once the request is in the
             # queue the scheduler thread may admit (even finish) it, and
-            # the timeline must stay in lifecycle order
+            # the timeline must stay in lifecycle order. The trace
+            # opens here too — AFTER every rejection path above, so a
+            # refused submit (queue full, tenant 429, draining) can
+            # never leak into the recorder's live set, and before the
+            # append, so the scheduler cannot finish the request ahead
+            # of its trace existing.
+            if self.trace_recorder is not None:
+                tr = self.trace_recorder.begin(req, trace_ctx)
+                if tr is not None and tenant is not None:
+                    tr.annotate(tenant=tenant)
             req.record_event("submit", req.submit_time)
             self.metrics.observe_submit(req)
             self._pending.append(req)
@@ -1167,6 +1202,8 @@ class PagedInferenceServer:
         that ends a request (finish / cancel / fail) goes through here
         so the telemetry can never miss a terminal state."""
         self.metrics.observe_finish(req)
+        if self.trace_recorder is not None and req.trace is not None:
+            self.trace_recorder.finish(req)
         req._done.set()
 
     def generate(self, prompts, *, max_new_tokens=None):
@@ -1533,6 +1570,13 @@ class PagedInferenceServer:
         st = self._iter_stats  # flight recorder: prefill share per iter
         st.setdefault("scheduler", self.scheduler)
         st["prefill_tokens"] = st.get("prefill_tokens", 0) + w * g
+        if self.trace_recorder is not None:
+            for sid in job.slots:
+                r = self._slots[sid].req
+                if r.trace is not None:
+                    self._iter_spans.append(
+                        (r, "prefill_chunk",
+                         {"slot": sid, "tokens": w, "chunk": c}))
         chunk = pad_rows(job.rows[:, c * w:(c + 1) * w],
                          self.infer_cfg.pad_token_id)
         g_lens = pad_rows(job.base_lens + c * w, 0)
@@ -1773,6 +1817,8 @@ class PagedInferenceServer:
             decode_tokens=len(live_ids) * self.window * n,
             decode_rows=int(live_g.shape[0]),
             compaction_ratio=len(live_ids) / max(int(live_g.shape[0]), 1))
+        if self.trace_recorder is not None:
+            self._stage_decode_spans(live_ids, n)
         args = (jnp.asarray(lengths), jnp.asarray(tables),
                 jnp.asarray(last_np), jnp.asarray(live_g))
         samp = jax.tree.map(jnp.asarray, samp_g)
@@ -1926,6 +1972,14 @@ class PagedInferenceServer:
             scheduler="mixed", n_live=n_live, decode_rounds=n_rounds,
             decode_tokens=n_live * self.window * n_rounds,
             prefill_tokens=sum(t for _, t in sel))
+        if self.trace_recorder is not None:
+            for job, take in sel:
+                r = self._slots[job.slots[0]].req
+                if r.trace is not None:
+                    self._iter_spans.append(
+                        (r, "prefill_chunk",
+                         {"slot": job.slots[0], "tokens": take,
+                          "offset": job.done}))
 
         # -- ragged prefill group (one row per selected admission) ----------
         pad_tok = self.infer_cfg.pad_token_id
@@ -1989,6 +2043,8 @@ class PagedInferenceServer:
             decode_rows=int(live_g.shape[0]) if n_rounds else 0,
             compaction_ratio=(n_live / max(int(live_g.shape[0]), 1)
                               if n_rounds else 1.0))
+        if self.trace_recorder is not None and n_rounds > 0:
+            self._stage_decode_spans(live_ids, n_rounds)
         if n_rounds == 0:
             live_g = np.zeros_like(live_g)
         use_rows_d = bool((self._needs_rows & live).any())
@@ -2100,13 +2156,32 @@ class PagedInferenceServer:
             finally:
                 self.tracer.step_end()
 
+    def _stage_decode_spans(self, live_ids, n_rounds: int) -> None:
+        """Stage one decode_segment span per traced live slot for this
+        iteration's decode dispatch (stamped with the shared iteration
+        frame by _record_iteration)."""
+        for sid in live_ids:
+            s = self._slots[int(sid)]
+            if s is not None and s.req.trace is not None:
+                self._iter_spans.append(
+                    (s.req, "decode_segment",
+                     {"slot": int(sid), "rounds": n_rounds}))
+
     def _record_iteration(self, t0: float, p0: int) -> None:
         """Flight-recorder epilogue for one busy scheduler iteration:
         the dispatch paths filled `_iter_stats` with their token split;
         this adds the budget/occupancy derived fields and appends ONE
         ring-buffer record. Idle iterations (nothing dispatched) leave
         `_iter_stats` empty and record nothing, so the ring holds the
-        last N *busy* iterations."""
+        last N *busy* iterations.
+
+        Tracing epilogue too: spans the dispatch paths staged this
+        iteration are stamped with the SAME (t0, now) frame and the
+        flight-recorder iteration index — the cross-link that lets a
+        slow span answer "what else was the scheduler doing that
+        iteration" in one hop, at the cost of zero extra clock reads
+        beyond the duration_ms one the recorder already pays."""
+        spans, self._iter_spans = self._iter_spans, []
         st = self._iter_stats
         if not st:
             return
@@ -2128,9 +2203,15 @@ class PagedInferenceServer:
                 for k, v in self.qos.fair_shares().items()}
         st["n_jobs"] = len(self._jobs)
         st["pending"] = self.num_pending
-        st["duration_ms"] = (time.perf_counter() - t0) * 1e3
+        now = time.perf_counter()
+        st["duration_ms"] = (now - t0) * 1e3
         st["ts"] = time.time()
         self.flight.record(**st)
+        if spans:
+            idx = self.flight.iterations
+            for req, name, tags in spans:
+                req.trace.add_span(name, t0, now, iteration=idx,
+                                   **tags)
 
     # -- observability ------------------------------------------------------
 
@@ -2180,12 +2261,40 @@ class PagedInferenceServer:
                     ).set_total(stats.evictions)
         if self.qos is not None:
             self.qos.mirror_metrics(reg)
+        if self.slo is not None:
+            self.slo.mirror_metrics(reg)
 
     def metrics_snapshot(self) -> dict:
         """Mergeable snapshot of every registered metric (the /metrics
         and /stats source; ReplicatedRouter merges these across
         replicas)."""
         return self.metrics.registry.snapshot()
+
+    @property
+    def ready(self) -> bool:
+        """Readiness (vs the liveness /healthz always reported): False
+        while draining or stopped, so load balancers — and the
+        ReplicatedRouter's placement — stop routing new work here
+        while in-flight requests finish."""
+        return not self._draining and not self._stop.is_set()
+
+    def lookup_trace(self, request_id: str) -> dict | None:
+        """Span tree for one sampled request id (live or retained),
+        else None (unsampled, evicted, or tracing disabled)."""
+        rec = self.trace_recorder
+        return None if rec is None else rec.lookup(request_id)
+
+    def trace_trees(self, n: int | None = None) -> list[dict]:
+        """Span trees of the sampled ring + live requests (the
+        /traces export source)."""
+        rec = self.trace_recorder
+        return [] if rec is None else rec.trees(n)
+
+    def slo_report(self) -> dict | None:
+        """Per-class SLO attainment + burn rates (the /slo source;
+        ReplicatedRouter merges these across replicas). None when no
+        SLO config is set."""
+        return None if self.slo is None else self.slo.report()
 
     def flight_window(self, n: int | None = None) -> list[dict]:
         """The last `n` (default: all retained) per-iteration flight

@@ -318,6 +318,12 @@ class TenantRegistry:
         st = self._state(self.resolve(tenant))
         return PRIORITY_CLASSES.index(st.cfg.priority)
 
+    def priority_class(self, tenant: str | None) -> str:
+        """The tenant's priority-class NAME ("interactive" / "batch" /
+        "best_effort") — the SLO layer's class mapping (a request's
+        SLO class is its tenant's priority class)."""
+        return self._state(self.resolve(tenant)).cfg.priority
+
     def weight(self, tenant: str | None) -> float:
         return self._state(self.resolve(tenant)).cfg.weight
 

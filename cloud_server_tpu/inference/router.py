@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 import zlib
 from typing import Sequence
 
@@ -79,29 +80,48 @@ class ReplicatedRouter:
 
     def _pick(self, *, tenant: str | None = None,
               count_inflight: bool = False) -> int:
+        n = len(self.replicas)
         loads = [r.num_active + r.num_pending + inf
                  for r, inf in zip(self.replicas, self._inflight)]
         if tenant is None:
-            k = next(self._rr) % len(self.replicas)
+            k = next(self._rr) % n
         else:
             # tenant-affinity tie-break: a stable per-tenant home
             # offset (crc32, not hash() — PYTHONHASHSEED-independent)
             # so an idle fleet serves a tenant from one replica (its
             # prompts hit that replica's radix prefix cache) while
             # least-loaded still wins under any load skew
-            k = zlib.crc32(tenant.encode()) % len(self.replicas)
+            k = zlib.crc32(tenant.encode()) % n
+        # readiness-aware placement: a draining (or stopped) replica
+        # advertises ready=False and stops receiving new work — its
+        # in-flight requests finish undisturbed. With the WHOLE fleet
+        # unready the pick falls back to all replicas so the submit
+        # surfaces the replica's own "draining" refusal instead of an
+        # index error.
+        cands = [j for j, r in enumerate(self.replicas)
+                 if getattr(r, "ready", True)] or list(range(n))
         # least loaded; ties resolve round-robin from k
-        i = min(range(len(loads)),
-                key=lambda i: (loads[i], (i - k) % len(loads)))
+        i = min(cands, key=lambda j: (loads[j], (j - k) % n))
         if count_inflight:
             self._inflight[i] += 1
         return i
 
     def submit(self, prompt, **kw):
+        t0 = time.perf_counter()
         with self._lock:
             i = self._pick(tenant=kw.get("tenant"), count_inflight=True)
         try:
-            return self.replicas[i].submit(prompt, **kw)
+            req = self.replicas[i].submit(prompt, **kw)
+            tr = getattr(req, "trace", None)
+            if tr is not None:
+                # the fleet half of the request's ONE span tree: the
+                # routing decision as an explicit span (pick through
+                # replica-submit return) + the replica tag every
+                # replica-side span inherits via the root
+                tr.annotate(replica=i)
+                tr.add_span("router_pick", t0, time.perf_counter(),
+                            replica=i)
+            return req
         finally:
             # the request is now in the replica's pending queue (or was
             # rejected) — either way its load is visible/settled again
@@ -154,6 +174,12 @@ class ReplicatedRouter:
         return sum(r.num_pending for r in self.replicas)
 
     @property
+    def ready(self) -> bool:
+        """Fleet readiness: True while ANY replica accepts new work
+        (a draining replica only removes itself from placement)."""
+        return any(getattr(r, "ready", True) for r in self.replicas)
+
+    @property
     def tokens_emitted(self) -> int:
         return sum(r.tokens_emitted for r in self.replicas)
 
@@ -177,6 +203,30 @@ class ReplicatedRouter:
             t = (entry.get("labels") or {}).get("tenant")
             if t in tstats:
                 entry["value"] = tstats[t]["fair_share"]
+        # same rule for the SLO ratio gauges: attainment/burn recompute
+        # from the fleet-merged good/total counts, never by adding the
+        # per-replica ratios (two 0.99-attaining replicas must read
+        # 0.99, not 1.98)
+        srep = self.slo_report()
+        if srep is not None:
+            for key, entry in merged.items():
+                if not (key.startswith("cloud_server_slo_attainment{")
+                        or key.startswith("cloud_server_slo_burn_rate{")):
+                    continue
+                lbl = entry.get("labels") or {}
+                went = (srep["classes"]
+                        .get(lbl.get("class"), {})
+                        .get("metrics", {})
+                        .get(lbl.get("metric"), {})
+                        .get("windows", {})
+                        .get(lbl.get("window_s")))
+                if went is None:
+                    continue
+                if "attainment{" in key:
+                    att = went["attainment"]
+                    entry["value"] = 1.0 if att is None else att
+                else:
+                    entry["value"] = went["burn_rate"]
         return merged
 
     @property
@@ -214,6 +264,47 @@ class ReplicatedRouter:
         for name, s in merged.items():
             s["fair_share"] = shares[name]
         return merged
+
+    def lookup_trace(self, request_id: str) -> dict | None:
+        """Span tree for one sampled request, wherever it ran: the
+        first replica that knows the id answers, tagged with its
+        replica index (router-submitted requests already carry it from
+        the router_pick span)."""
+        for i, r in enumerate(self.replicas):
+            fn = getattr(r, "lookup_trace", None)
+            tree = fn(request_id) if fn is not None else None
+            if tree is not None:
+                tree["root"]["tags"].setdefault("replica", i)
+                return tree
+        return None
+
+    def trace_trees(self, n: int | None = None) -> list[dict]:
+        """FLEET-wide sampled span trees (the /traces source), each
+        tagged with its replica index and ordered by root start
+        (n <= 0 means "no trees", the recorder's own rule)."""
+        if n is not None and n <= 0:
+            return []
+        out = []
+        for i, r in enumerate(self.replicas):
+            fn = getattr(r, "trace_trees", None)
+            if fn is None:
+                continue
+            for tree in fn(n):
+                tree["root"]["tags"].setdefault("replica", i)
+                out.append(tree)
+        out.sort(key=lambda t: t["root"]["start"])
+        return out if n is None else out[-n:]
+
+    def slo_report(self) -> dict | None:
+        """FLEET-wide SLO attainment + burn rates: every replica's
+        report merged by summing good/total counts per (class, metric,
+        window) and recomputing the ratios — the control signal the
+        future autoscaler consumes. None when no replica tracks
+        SLOs."""
+        from cloud_server_tpu.inference.slo import merge_reports
+        return merge_reports(
+            r.slo_report() for r in self.replicas
+            if hasattr(r, "slo_report"))
 
     def flight_window(self, n: int | None = None) -> list[dict]:
         """Recent flight-recorder records across the fleet, each tagged

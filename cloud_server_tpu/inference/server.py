@@ -453,6 +453,14 @@ class Request:
     # a QoS-enabled server always carry a concrete name ("default" when
     # the client sent none).
     tenant: str | None = None
+    # distributed tracing (inference/request_trace.py): the request's
+    # RequestTrace when head sampling selected it at submit, else None
+    # (unsampled, or tracing disabled — zero cost either way)
+    trace: object | None = None
+    # SLO class (inference/slo.py): the tenant's QoS priority class
+    # name, resolved once at submit when SLO tracking is configured;
+    # None otherwise (the tracker maps None onto its "default" entry)
+    slo_class: str | None = None
     tokens: list[int] = dataclasses.field(default_factory=list)
     # log P(token) under the model's raw (pre-filter) distribution,
     # aligned with `tokens`
@@ -619,7 +627,7 @@ class InferenceServer:
                  prefix_tokens: Sequence[int] | None = None,
                  prefix_remainder_cap: int = 1024,
                  metrics: ServingMetrics | None = None,
-                 qos=None):
+                 qos=None, tracing=None, slo=None):
         # Serving never needs f32 master weights: pre-cast float32 leaves to
         # the compute dtype once, instead of streaming 2x the bytes and
         # converting on every decode step. QTensor leaves stay quantized
@@ -714,6 +722,18 @@ class InferenceServer:
         # lazily — qos.py imports QueueFullError from this module.
         from cloud_server_tpu.inference.qos import resolve_registry
         self.qos = resolve_registry(qos, infer_cfg.qos_config)
+        # per-request distributed tracing + per-class SLO tracking
+        # (inference/request_trace.py, inference/slo.py): both None
+        # unless configured — every guarded call site short-circuits
+        # and the scheduler is byte-identical to the pre-trace build
+        from cloud_server_tpu.inference.request_trace import (
+            resolve_recorder)
+        from cloud_server_tpu.inference.slo import resolve_slo
+        self.trace_recorder = resolve_recorder(
+            tracing, infer_cfg.trace_sample_rate)
+        self.slo = resolve_slo(slo, infer_cfg.slo_config)
+        if self.slo is not None:
+            self.metrics.slo = self.slo
         self._draining = False
         self._slots: list[Request | None] = [None] * max_slots
         self._pending: collections.deque[Request] = collections.deque()
@@ -735,7 +755,8 @@ class InferenceServer:
                max_new_tokens: int | None = None,
                stream: Callable[[int], None] | None = None,
                sampling: SamplingParams | None = None,
-               tenant: str | None = None) -> Request:
+               tenant: str | None = None,
+               trace_ctx: tuple | None = None) -> Request:
         if self._stop.is_set():
             # stop() was called or serve_forever died on a fatal error —
             # accepting now would enqueue work nothing will ever drain and
@@ -767,6 +788,11 @@ class InferenceServer:
                       seed_used=resolve_seed(sampling, self._host_rng,
                                              self._lock),
                       submit_time=time.perf_counter())
+        if self.slo is not None:
+            # class mapping: the tenant's QoS priority class; plain
+            # "default" without a registry
+            req.slo_class = (self.qos.priority_class(tenant)
+                             if self.qos is not None else None)
         req._on_cancel = self._handle_cancel
         with self._lock:
             # under the lock: drain() flips _draining under the same
@@ -788,7 +814,15 @@ class InferenceServer:
                 self.qos.gate_submit(tenant, len(prompt))
             # telemetry BEFORE the append: once the request is in the
             # queue the scheduler thread may admit (even finish) it, and
-            # the timeline must stay in lifecycle order
+            # the timeline must stay in lifecycle order. The trace
+            # opens here too — AFTER every rejection path above, so a
+            # refused submit can never leak into the recorder's live
+            # set, and before the append, so the scheduler cannot
+            # finish the request ahead of its trace existing.
+            if self.trace_recorder is not None:
+                tr = self.trace_recorder.begin(req, trace_ctx)
+                if tr is not None and tenant is not None:
+                    tr.annotate(tenant=tenant)
             req.record_event("submit", req.submit_time)
             self.metrics.observe_submit(req)
             self._pending.append(req)
@@ -814,6 +848,8 @@ class InferenceServer:
         unblock waiters. Every path that ends a request goes through
         here so the telemetry can never miss a terminal state."""
         self.metrics.observe_finish(req)
+        if self.trace_recorder is not None and req.trace is not None:
+            self.trace_recorder.finish(req)
         req._done.set()
 
     def _sweep_cancelled(self) -> None:
@@ -1142,12 +1178,40 @@ class InferenceServer:
                     ).set_total(self.prefix_misses)
         if self.qos is not None:
             self.qos.mirror_metrics(reg)
+        if self.slo is not None:
+            self.slo.mirror_metrics(reg)
 
     def metrics_snapshot(self) -> dict:
         """Mergeable snapshot of every registered metric (the /metrics
         and /stats source; ReplicatedRouter merges these across
         replicas)."""
         return self.metrics.registry.snapshot()
+
+    @property
+    def ready(self) -> bool:
+        """Readiness (vs the liveness /healthz always reported): False
+        while draining or stopped, so load balancers — and the
+        ReplicatedRouter's placement — stop routing new work here
+        while in-flight requests finish."""
+        return not self._draining and not self._stop.is_set()
+
+    def lookup_trace(self, request_id: str) -> dict | None:
+        """Span tree for one sampled request id (live or retained),
+        else None (unsampled, evicted, or tracing disabled)."""
+        rec = self.trace_recorder
+        return None if rec is None else rec.lookup(request_id)
+
+    def trace_trees(self, n: int | None = None) -> list[dict]:
+        """Span trees of the sampled ring + live requests (the
+        /traces export source)."""
+        rec = self.trace_recorder
+        return [] if rec is None else rec.trees(n)
+
+    def slo_report(self) -> dict | None:
+        """Per-class SLO attainment + burn rates (the /slo source;
+        ReplicatedRouter merges these across replicas). None when no
+        SLO config is set."""
+        return None if self.slo is None else self.slo.report()
 
     def request_trace(self, n_steps: int,
                       logdir: str | os.PathLike) -> None:

@@ -350,9 +350,17 @@ class ServingMetrics:
     on the request (host wall clock at points where the host blocks on
     device output anyway), so instrumentation adds zero device syncs
     and zero dispatches — guarded by the dispatch-count regression test
-    in tests/test_observability.py."""
+    in tests/test_observability.py.
 
-    def __init__(self, registry: MetricsRegistry | None = None):
+    `slo` (an inference.slo.SLOTracker, attached by a server that
+    resolved an SLO config) receives the same latency observations,
+    tagged with the request's priority class (`req.slo_class`), at the
+    same already-owned host moments; None (the default) keeps every
+    hook byte-identical to the pre-SLO build."""
+
+    def __init__(self, registry: MetricsRegistry | None = None,
+                 slo=None):
+        self.slo = slo
         r = self.registry = registry or MetricsRegistry()
         self.ttft = r.histogram(
             "ttft_seconds", "Time from submit to first emitted token")
@@ -386,6 +394,9 @@ class ServingMetrics:
             req.admit_time = now
             if req.submit_time is not None:
                 self.queue_wait.observe(now - req.submit_time)
+                if self.slo is not None:
+                    self.slo.observe(req.slo_class, "queue_wait",
+                                     now - req.submit_time, now)
 
     def observe_emit(self, req) -> None:
         """Called after emit_token appended a timestamp (the host moment
@@ -397,6 +408,9 @@ class ServingMetrics:
             if req.submit_time is not None:
                 ttft = times[0] - req.submit_time
                 self.ttft.observe(ttft)
+                if self.slo is not None:
+                    self.slo.observe(req.slo_class, "ttft", ttft,
+                                     times[0])
                 tenant = getattr(req, "tenant", None)
                 if tenant:
                     # once per request (not per token): the per-tenant
@@ -406,6 +420,9 @@ class ServingMetrics:
                         labels={"tenant": tenant}).observe(ttft)
         elif len(times) >= 2:
             self.itl.observe(times[-1] - times[-2])
+            if self.slo is not None:
+                self.slo.observe(req.slo_class, "itl",
+                                 times[-1] - times[-2], times[-1])
 
     def observe_requeue(self, req, now: float) -> None:
         req.record_event("preempt_requeue", now)
@@ -423,6 +440,9 @@ class ServingMetrics:
             self.finished.inc()
         if req.submit_time is not None:
             self.e2e.observe(now - req.submit_time)
+            if self.slo is not None:
+                self.slo.observe(req.slo_class, "e2e",
+                                 now - req.submit_time, now)
 
 
 # ---------------------------------------------------------------------------

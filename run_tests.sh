@@ -35,6 +35,16 @@ if [ "$1" = "--all" ]; then
 fi
 if [ "$#" -eq 0 ]; then set -- -x -q; fi
 
+# Hot-path lint as an EXPLICIT suite step (stdlib-only, ~instant), not
+# only via tests/test_analysis.py: the per-iteration scheduler code in
+# the scan roster (qos.py, serving_metrics.py, request_trace.py's
+# span-record path, slo.py) must stay free of device work, blocking
+# syncs, numpy allocation, wall-clock reads, and host I/O — and a
+# failure here reads as "hot-path regression", loudly, before any
+# pytest output scrolls past.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m cloud_server_tpu.analysis || exit $?
+
 shopt -s nullglob  # an empty group must not reach pytest as a literal
 rc=0
 # four groups: p-r carries the biggest graphs (paged server, pipeline,

@@ -32,6 +32,42 @@ def test_registry_covers_qos_admission_policy():
         assert needed in quals, f"{needed} dropped from HOT_PATHS"
 
 
+def test_registry_covers_tracing_and_slo():
+    """The span-record path (request_trace.py) and the SLO observe
+    path (slo.py) ride inside the scheduler iteration alongside the
+    QoS policy — they must stay on the scan roster."""
+    trace_quals = set(
+        HOT_PATHS["cloud_server_tpu/inference/request_trace.py"])
+    for needed in ("RequestTrace.add_span", "TraceRecorder.begin",
+                   "TraceRecorder.finish"):
+        assert needed in trace_quals, f"{needed} dropped from HOT_PATHS"
+    slo_quals = set(HOT_PATHS["cloud_server_tpu/inference/slo.py"])
+    for needed in ("SLOTracker.observe", "_RollingCounts.observe"):
+        assert needed in slo_quals, f"{needed} dropped from HOT_PATHS"
+
+
+def test_checker_flags_bad_trace_and_slo_paths():
+    """Fixture round-trip for the NEW roster entries' violation
+    shapes: wall-clock span stamps, per-span numpy buffers, logging,
+    I/O and sleeps inside observe — each must fire; the pure
+    passed-timestamp shape the real modules use must not."""
+    src = (_FIXTURES / "hot_path_trace_bad.py").read_text()
+    cases = {
+        "BadRecorder.add_span_wall_clock": "time.time",
+        "BadRecorder.add_span_numpy": "numpy",
+        "BadRecorder.add_span_logged": "logging",
+        "BadSLO.observe_io": "I/O",
+        "BadSLO.observe_sleepy": "sleep",
+    }
+    for qual, needle in cases.items():
+        findings = check_source("hot_path_trace_bad.py", src, (qual,))
+        assert findings, f"{qual}: expected a finding"
+        assert any(needle in f.message for f in findings), \
+            f"{qual}: {[str(f) for f in findings]}"
+    assert not check_source("hot_path_trace_bad.py", src,
+                            ("BadSLO.observe_fine",))
+
+
 def test_checker_accepts_clean_fixture():
     src = (_FIXTURES / "hot_path_good.py").read_text()
     findings = check_source("hot_path_good.py", src,

@@ -77,6 +77,37 @@ def test_generate_text_prompt_decodes(frontend):
     assert all("text" in ln for ln in lines[:-1])
 
 
+def test_healthz_readiness_tracks_drain():
+    """`ready` (vs `ok` liveness) flips false while the backend drains
+    — the load-balancer shed signal — and back on resume; `ok` and the
+    counts stay up throughout."""
+    params = transformer.init_params(CFG, jax.random.key(0))
+    srv = PagedInferenceServer(
+        params, CFG, GREEDY, max_slots=2, max_context=64, page_size=8,
+        prefill_chunk=16, prompt_buckets=[16, 48]).start()
+    front = HttpFrontend(srv).start()
+    try:
+        host, port = front.address
+
+        def health():
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/healthz", timeout=30) as resp:
+                return json.loads(resp.read())
+
+        assert health() == {"ok": True, "ready": True, "active": 0,
+                            "pending": 0}
+        assert srv.drain() is True  # idle: quiesces immediately
+        h = health()
+        assert h["ok"] is True and h["ready"] is False
+        srv.resume()
+        assert health()["ready"] is True
+        srv.stop()  # stopped: live HTTP layer, unready backend
+        assert health()["ready"] is False
+    finally:
+        front.stop()
+        srv.stop()
+
+
 def test_healthz_and_errors(frontend):
     front, _ = frontend
     host, port = front.address
@@ -84,6 +115,7 @@ def test_healthz_and_errors(frontend):
                                 timeout=30) as resp:
         health = json.loads(resp.read())
     assert health["ok"] is True
+    assert health["ready"] is True  # serving: ready to take traffic
     with pytest.raises(urllib.error.HTTPError) as err:
         _post(front, {"nonsense": 1})
     assert err.value.code == 400

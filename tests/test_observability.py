@@ -277,14 +277,29 @@ def test_lifecycle_monotonic_preempt_requeue(params):
 # ---------------------------------------------------------------------------
 
 
-def test_mixed_step_dispatch_and_sync_count(params, monkeypatch):
+_TRACING_SLO_KW = {
+    "tracing": 1.0,
+    "slo": {"windows_s": [10, 60],
+            "classes": {"default": {"objective": 0.99, "ttft_s": 30.0,
+                                    "itl_s": 30.0, "queue_wait_s": 30.0,
+                                    "e2e_s": 120.0}}}}
+
+
+@pytest.mark.parametrize("extra_kw", [{}, _TRACING_SLO_KW],
+                         ids=["plain", "tracing_slo"])
+def test_mixed_step_dispatch_and_sync_count(params, monkeypatch,
+                                            extra_kw):
     """The instrumented mixed-scheduler iteration still issues exactly
     ONE fused dispatch and ONE host sync per step while admissions are
     in flight — the telemetry observes timestamps the scheduler already
-    had, it never adds device work."""
+    had, it never adds device work. The `tracing_slo` clone runs the
+    SAME invariant with per-request tracing at 100% head sampling AND
+    SLO tracking enabled: span recording and burn-rate accounting are
+    host-side list/int work on already-owned timestamps, zero
+    dispatches or syncs."""
     from cloud_server_tpu.inference import paged_server as ps
     srv = PagedInferenceServer(params, CFG, GREEDY, scheduler="mixed",
-                               **PAGED_KW)
+                               **PAGED_KW, **extra_kw)
     warm = srv.submit([5, 9, 3, 1], max_new_tokens=24)
     srv.step()  # warm decode running before the long prompt lands
     assert srv.num_active == 1
@@ -325,6 +340,10 @@ def test_mixed_step_dispatch_and_sync_count(params, monkeypatch):
     assert warm.done and long.done
     assert srv.metrics_snapshot()[
         "cloud_server_requests_finished_total"]["value"] == 2
+    if extra_kw:  # the clone really ran with both layers live
+        assert len(srv.trace_trees()) == 2
+        assert srv.slo_report()["classes"]["default"]["metrics"][
+            "e2e"]["lifetime"]["total"] == 2
 
 
 # ---------------------------------------------------------------------------
@@ -496,8 +515,11 @@ def test_metric_catalog_matches_docs(params):
                              re.M))
     contig = InferenceServer(params, CFG, GREEDY, max_slots=1,
                              max_len=64, prompt_buckets=[16])
+    # qos + slo so the per-tenant AND per-class labeled families
+    # register (labeled series are cataloged by family name)
     paged = PagedInferenceServer(params, CFG, GREEDY,
-                                 qos={"tenants": {"a": {}}}, **PAGED_KW)
+                                 qos={"tenants": {"a": {}}},
+                                 slo=_TRACING_SLO_KW["slo"], **PAGED_KW)
     runtime = {name.split("{")[0] for name in
                set(contig.metrics_snapshot())
                | set(paged.metrics_snapshot())}

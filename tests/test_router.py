@@ -104,6 +104,44 @@ def test_router_embeddings_and_adapters(params):
     assert all(len(q.tokens) == 4 for q in reqs)
 
 
+def test_router_skips_draining_replica(params):
+    """A draining replica advertises ready=False and stops receiving
+    NEW work from the router (its in-flight requests finish); resume()
+    puts it back in rotation, and a fully-draining fleet surfaces the
+    replica's own refusal instead of hanging or index-erroring."""
+    r = ReplicatedRouter.over_devices(
+        params, CFG, GREEDY, devices=jax.devices()[:2], **SRV_KW)
+    inflight = r.replicas[0].submit(PROMPT, max_new_tokens=6)
+    assert r.replicas[0].drain(timeout=0.0) is False  # still busy
+    # quiesce-style drain latch without waiting for idle: use the
+    # stop(drain)-internal latch semantics via drain on the now-idle
+    # replica after finishing its work
+    r.run_until_idle()
+    assert inflight.done
+    assert r.replicas[0].drain() is True  # idle: latched draining
+    assert r.replicas[0].ready is False
+    assert r.ready is True  # fleet still ready: replica 1 serves
+    reqs = [r.submit(PROMPT, max_new_tokens=4) for _ in range(4)]
+    r.run_until_idle()
+    assert all(len(q.tokens) == 4 for q in reqs)
+    # every request landed on the non-draining replica
+    snap0 = r.replicas[0].metrics_snapshot()
+    snap1 = r.replicas[1].metrics_snapshot()
+    assert snap0["cloud_server_requests_submitted_total"]["value"] == 1
+    assert snap1["cloud_server_requests_submitted_total"]["value"] == 4
+    # back in rotation after resume
+    r.replicas[0].resume()
+    assert r.replicas[0].ready is True
+    # whole fleet draining: submit surfaces the replicas' refusal
+    for rep in r.replicas:
+        assert rep.drain() is True
+    assert r.ready is False
+    with pytest.raises(RuntimeError, match="draining"):
+        r.submit(PROMPT, max_new_tokens=2)
+    for rep in r.replicas:
+        rep.resume()
+
+
 def test_burst_submit_sees_inflight_picks():
     """ADVICE r5: a submit still blocked inside its replica (the router
     lock is not held across replica.submit) must be visible to
