@@ -353,6 +353,13 @@ def serving_bench():
         print(f"[serving_bench] churn skipped after error: {exc!r}",
               flush=True)
         out["churn_error"] = repr(exc)[:160]
+    # speculation-under-churn three-way A/B (same guard discipline)
+    try:
+        out.update(_spec_churn_bench(params_bf16, base, infer_cfg))
+    except Exception as exc:  # noqa: BLE001
+        print(f"[serving_bench] churn_spec skipped after error: "
+              f"{exc!r}", flush=True)
+        out["churn_spec_error"] = repr(exc)[:160]
     # multi-tenant QoS isolation A/B (same guard discipline)
     try:
         out.update(_qos_isolation_bench(params_bf16, base, infer_cfg))
@@ -360,6 +367,141 @@ def serving_bench():
         print(f"[serving_bench] qos_isolation skipped after error: "
               f"{exc!r}", flush=True)
         out["qos_isolation_error"] = repr(exc)[:160]
+    return out
+
+
+def _spec_churn_bench(params, base, infer_cfg):
+    """Speculation composed with stall-free batching, the PR 9 win: a
+    three-way A/B under admission churn on a repetition-heavy prompt
+    mix (the n-gram sweet spot — code/tables-like local repetition):
+
+      * `churn_spec_*`            — mixed + ADAPTIVE n-gram speculation
+                                    (the default controller);
+      * `churn_spec_mixed_plain_*` — mixed, no speculation (what the
+                                    speculative arm must beat for the
+                                    window to pay under churn);
+      * `churn_spec_alternating_spec_*` — alternating + fixed-length
+                                    n-gram speculation (paying the
+                                    churn cliff mixed batching fixed).
+
+    A fourth arm, `churn_spec_draft_model_*`, drives the composition
+    this PR made POSSIBLE — DRAFT-MODEL speculation under the mixed
+    scheduler (pre-PR it silently forced alternating; mixed+n-gram
+    always worked) — through the same churn scenario, one fused
+    dispatch per iteration. Its accept rate reflects the random-init
+    draft here (the controller walks poor acceptors off); trained
+    draft-model acceptance is measured by the trained_spec section.
+
+    Every arm reports tok/s, decode-ITL p99 (ms, the equal-latency
+    check), and — speculative arms — committed tokens per decode round.
+    A final pair measures the ADAPTIVE FLOOR on the random-prompt
+    (low-acceptance) mix: `spec_adaptive_floor_ratio` = adaptive-spec
+    tok/s / plain tok/s, which must hover ~1.0 — the controller walks
+    every slot to plain decode instead of paying dead verify windows.
+    Each scenario runs twice (untimed compile warm-up, then timed)."""
+    import dataclasses
+
+    import numpy as np
+
+    from cloud_server_tpu.models import transformer
+
+    from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+
+    cfg = dataclasses.replace(base, decode_attention_impl="pallas")
+    # greedy so acceptance reflects the model, not sampling noise
+    greedy = dataclasses.replace(infer_cfg, temperature=0.0)
+    # tiny random-init draft sharing the target's vocab: exercises the
+    # fused draft prefill/decode discipline under churn (acceptance is
+    # draft-quality dependent; see docstring)
+    draft_cfg = dataclasses.replace(
+        base, embed_dim=256, num_layers=2, num_heads=4, num_kv_heads=4,
+        mlp_dim=1024)
+    draft_params = transformer.init_params(draft_cfg, jax.random.key(11))
+
+    def scenario(scheduler, spec, spec_control, rep, draft=False):
+        # every arm (and each arm's warm-up vs timed run) draws the
+        # IDENTICAL prompt sequence: the A/B ratios must compare
+        # schedulers, not prompt-mix noise
+        rng = np.random.RandomState(3)
+
+        def mk(n):
+            if rep:
+                pat = [int(x) for x in rng.randint(1, 30000, size=8)]
+                return (pat * (n // 8 + 1))[:n]
+            return [int(x) for x in rng.randint(1, 30000, size=n)]
+        srv = PagedInferenceServer(
+            params, cfg, greedy, max_slots=16, max_context=1024,
+            page_size=128, prefill_chunk=256, decode_chunk=8,
+            prompt_buckets=[64, 256, 512], scheduler=scheduler,
+            spec_drafts=spec, spec_control=spec_control,
+            draft_params=draft_params if draft else None,
+            draft_cfg=draft_cfg if draft else None)
+        assert srv._mixed_enabled == (scheduler == "mixed")
+        first = [srv.submit(mk(64), max_new_tokens=256)
+                 for _ in range(8)]
+        for _ in range(2):
+            srv.step()
+        t0 = time.perf_counter()
+        r0, c0 = srv.decode_rounds, srv.decode_tokens_committed
+        waves = []
+        # three waves of long admissions while the first batch decodes:
+        # the regime where alternating+spec used to stall
+        for _ in range(3):
+            waves += [srv.submit(mk(400), max_new_tokens=128)
+                      for _ in range(4)]
+            for _ in range(6):
+                srv.step()
+        srv.run_until_idle()
+        dt = time.perf_counter() - t0
+        total = sum(len(r.tokens) for r in first + waves)
+        accept = ((srv.decode_tokens_committed - c0)
+                  / max(srv.decode_rounds - r0, 1))
+        itls = []
+        for r in first:
+            itls += [b - a for a, b in zip(r.emit_times,
+                                           r.emit_times[1:])]
+        itls.sort()
+        p99 = (itls[min(len(itls) - 1, int(0.99 * len(itls)))]
+               if itls else 0.0)
+        srv.stop()
+        return total / dt, accept, p99 * 1e3
+
+    out = {}
+    arms = {
+        # (scheduler, spec_drafts, spec_control, repetitive, draft)
+        "churn_spec": ("mixed", 3, None, True, False),  # adaptive dflt
+        "churn_spec_mixed_plain": ("mixed", 0, False, True, False),
+        "churn_spec_alternating_spec": ("alternating", 3, False, True,
+                                        False),
+        "churn_spec_draft_model": ("mixed", 3, None, True, True),
+        "spec_adaptive_random": ("mixed", 3, None, False, False),
+        "spec_plain_random": ("mixed", 0, False, False, False),
+    }
+    for tag, (sched, spec, ctl, rep, draft) in arms.items():
+        scenario(sched, spec, ctl, rep, draft)  # warm-up compiles
+        tok_s, accept, itl_p99 = scenario(sched, spec, ctl, rep, draft)
+        out[f"{tag}_tok_s"] = tok_s
+        out[f"{tag}_itl_ms_p99"] = itl_p99
+        if spec:
+            out[f"{tag}_accept"] = accept
+        print(f"[serving_bench] {tag}: {tok_s:.1f} tok/s, itl_p99 "
+              f"{itl_p99:.1f} ms"
+              + (f", accept {accept:.2f} tok/round" if spec else ""),
+              flush=True)
+    out["churn_spec_speedup_vs_plain"] = (
+        out["churn_spec_tok_s"]
+        / max(out["churn_spec_mixed_plain_tok_s"], 1e-9))
+    out["churn_spec_speedup_vs_alternating"] = (
+        out["churn_spec_tok_s"]
+        / max(out["churn_spec_alternating_spec_tok_s"], 1e-9))
+    out["spec_adaptive_floor_ratio"] = (
+        out["spec_adaptive_random_tok_s"]
+        / max(out["spec_plain_random_tok_s"], 1e-9))
+    print(f"[serving_bench] churn_spec speedups: "
+          f"{out['churn_spec_speedup_vs_plain']:.2f}x vs mixed-plain, "
+          f"{out['churn_spec_speedup_vs_alternating']:.2f}x vs "
+          f"alternating+spec; adaptive floor "
+          f"{out['spec_adaptive_floor_ratio']:.2f}", flush=True)
     return out
 
 

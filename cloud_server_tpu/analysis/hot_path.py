@@ -61,6 +61,20 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "SLOTracker.resolve_class",
         "SLOTracker.observe",
     ),
+    # adaptive speculation control: planning (draft_len) and feedback
+    # (observe / on_plain_dispatch) run once per dispatch / committed
+    # round inside the scheduler iteration; draft_lengths feeds the
+    # per-busy-iteration flight record. resolve_controller (construction,
+    # may open a config file) is deliberately absent.
+    "cloud_server_tpu/inference/spec_control.py": (
+        "SpecController.on_admit",
+        "SpecController.on_release",
+        "SpecController.draft_len",
+        "SpecController.observe",
+        "SpecController.on_plain_dispatch",
+        "SpecController.accept_rate",
+        "SpecController.draft_lengths",
+    ),
     "cloud_server_tpu/inference/qos.py": (
         "TokenBucket._refill",
         "TokenBucket.level",
@@ -82,6 +96,7 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "TenantRegistry.order_jobs",
         "TenantRegistry.charge_prefill",
         "TenantRegistry.charge_generated",
+        "TenantRegistry.charge_speculation",
         # per-busy-iteration flight-recorder gauge
         "TenantRegistry.fair_shares",
         "TenantRegistry._fair_shares_locked",

@@ -221,6 +221,18 @@ class InferConfig:
     # headers propagate in and out. Constructor argument `tracing=`
     # (a rate or a ready TraceRecorder) overrides.
     trace_sample_rate: float = 0.0
+    # Adaptive speculative decoding (inference/spec_control.py): a JSON
+    # object as a string, or a path to a JSON file, with the controller
+    # knobs (low/high accept-rate hysteresis thresholds, ewma, cooldown,
+    # probe_period, initial draft length — schema in the module
+    # docstring and docs/serving.md). "" (the default) enables the
+    # DEFAULT adaptive controller whenever speculation is configured
+    # (spec_drafts > 0); the literal "off" pins the fixed spec_drafts
+    # draft length (the pre-adaptive behavior). A string keeps this
+    # dataclass hashable for jit static arguments; the paged server
+    # parses it at construction. Constructor argument `spec_control=`
+    # (a config, a ready SpecController, or False) overrides.
+    spec_control_config: str = ""
     # Per-class SLO targets (inference/slo.py): a JSON object as a
     # string, or a path to a JSON file, declaring per-priority-class
     # latency targets (ttft/itl/queue_wait/e2e) and attainment

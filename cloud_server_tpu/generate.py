@@ -83,6 +83,15 @@ def build_parser() -> argparse.ArgumentParser:
                    "with N n-gram draft tokens per round (exact accept "
                    "rule — output distribution unchanged; wins on "
                    "repetition-heavy output)")
+    p.add_argument("--spec-control", metavar="FILE_OR_JSON",
+                   default=None,
+                   help="adaptive speculative decoding knobs (JSON "
+                   "object/string or file path: low/high accept-rate "
+                   "hysteresis, ewma, cooldown, probe_period, initial "
+                   "draft length — inference/spec_control.py). Omitted: "
+                   "the default adaptive controller whenever "
+                   "speculation is on; 'off' pins the fixed "
+                   "--spec-drafts length")
     p.add_argument("--page-size", type=int, default=128,
                    help="paged server: tokens per KV page (multiple of 128 "
                    "for the pallas decode kernel on TPU)")
@@ -381,6 +390,7 @@ def main(argv=None) -> None:
             num_pages=args.num_pages or None,
             decode_chunk=args.decode_chunk,
             spec_drafts=spec,
+            spec_control=args.spec_control,
             prefill_chunk=prefill_chunk, seed=args.seed,
             allocation=args.allocation,
             scheduler=args.scheduler,

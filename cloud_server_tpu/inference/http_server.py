@@ -550,6 +550,13 @@ class HttpFrontend:
             # n bounds the window; n <= 0 means "no records", never
             # "everything" (256+ per-iteration dicts)
             payload["flight_recorder"] = fn(n) if n > 0 else []
+        # speculative decoding: drafted/accepted totals, the accept
+        # rate, and (adaptive) the live per-slot draft lengths.
+        # ReplicatedRouter's speculation_stats() merges counts across
+        # replicas and recomputes the rate from the merged totals.
+        sfn = getattr(self.srv, "speculation_stats", None)
+        if sfn is not None:
+            payload["speculation"] = sfn()
         # multi-tenant QoS: per-tenant counters + fair-share view.
         # ReplicatedRouter merges these across replicas
         # (tenant_stats()); a single server reports its registry's.

@@ -24,9 +24,8 @@ the contiguous slot cache). What the paged design buys:
     selected, each at its own width — no remainder-bucket grouping) and
     the full multi-round decode dispatch into a single jitted program
     with a single host sync. The alternating scheduler (kept as
-    scheduler="alternating", and used automatically under draft-model
-    speculation) instead pays one dispatch + sync per admission group
-    plus one per decode dispatch, and shrinks decode to
+    scheduler="alternating") instead pays one dispatch + sync per
+    admission group plus one per decode dispatch, and shrinks decode to
     `admit_decode_chunk` rounds whenever admissions are running — which
     is exactly the churn cliff the r5 bench measured (decode collapsing
     to ~10 steps across a whole admission phase). Greedy and seeded
@@ -47,7 +46,24 @@ the contiguous slot cache). What the paged design buys:
     with the exact accept/residual rule (`speculative._accept_point_mass`
     — output distribution provably unchanged; token-for-token greedy).
     No draft model, no extra memory; repetition-heavy decodes commit
-    several tokens per model pass.
+    several tokens per model pass. With a DRAFT MODEL
+    (`draft_params`/`draft_cfg`) the classic draft/verify loop runs the
+    same way — and BOTH sources now compose with the mixed scheduler:
+    the draft model's chunk prefill and per-round decode discipline are
+    part of the one fused `_mixed_step` program, so speculation no
+    longer forces the alternating scheduler.
+  * ADAPTIVE speculation (on by default whenever spec_drafts > 0;
+    `spec_control=` / `--spec-control`, inference/spec_control.py): a
+    host-side controller tracks a rolling accept rate per slot from
+    the per-round counts the scheduler already syncs and tunes each
+    slot's draft length between 0 (plain decode) and spec_drafts with
+    hysteresis; each row commits at most its own length (exact
+    truncation; dispatch width quantized to {0, spec_drafts} — one
+    compiled program per static width). Low-acceptance
+    workloads converge to plain decode instead of paying dead verify
+    windows; QoS generated-token buckets are charged only for
+    committed tokens while rejected draft work lands on a per-tenant
+    wasted-speculation counter.
 
 Scheduling state is HOST-authoritative (tables, lengths, active,
 last_token live in numpy and ride into each dispatch as small inputs);
@@ -100,8 +116,10 @@ from cloud_server_tpu.inference.sampling import (
 from cloud_server_tpu.inference.server import (
     QueueFullError, Request, _StepTracer, _bucket, _token_logprobs,
     emit_token, resolve_seed)
+from cloud_server_tpu.inference.spec_control import resolve_controller
 from cloud_server_tpu.inference.speculative import (
-    _accept_drafts, _accept_point_mass, _ngram_drafts)
+    _TAG_DRAFT, _accept_drafts, _accept_point_mass, _ngram_drafts,
+    _row_pos_keys, sample_from_probs_keyed)
 from cloud_server_tpu.utils.serving_metrics import (
     FlightRecorder, ServingMetrics)
 
@@ -325,11 +343,14 @@ def _prefill_core(params, state, chunk, g_lens, g_tables, sample_at,
         # the draft model prefills the same chunk into ITS pools (same
         # page ids / tables, draft geometry) so in-server draft-model
         # speculation has the full context cached — including shared
-        # prefix pages, which carry the draft kv alongside the target's
+        # prefix pages, which carry the draft kv alongside the target's.
+        # The mixed scheduler's RAGGED groups pass per-row `widths`:
+        # the draft's writes and attention honor each row's true
+        # progress exactly like the target's call above
         dcache = _make_cache(state["draft_pools"], g_lens, g_tables)
         _, dcache = paged_engine.window_forward(
             draft_params, chunk, draft_cfg, dcache, logits_at=None,
-            mesh=mesh)
+            mesh=mesh, widths=widths)
         new_state["draft_pools"] = _split_cache(dcache)
     hist = state["hist"]
     if scatter_prompt:
@@ -450,7 +471,7 @@ _decode_rounds = partial(jax.jit,
 def _spec_core(params, state, lengths, tables, last_token, live,
                stop_len, rng, samp_rows, gid=None, grammar=None,
                lora=None, aid=None,
-               draft_params=None, slot_ids=None, *,
+               draft_params=None, slot_ids=None, draft_limit=None, *,
                cfg: ModelConfig, infer_cfg: InferConfig, n_rounds: int,
                n_drafts: int, mesh=None, draft_cfg=None,
                use_rows: bool = False, use_bias: bool = False):
@@ -484,6 +505,25 @@ def _spec_core(params, state, lengths, tables, last_token, live,
     COMPACTION (`slot_ids`): as in `_decode_plain_core` — rows may be a
     gathered subset of slots; per-slot device state stays full-size and
     scatters go through slot_ids (sentinel rows drop).
+
+    ADAPTIVE draft lengths (`draft_limit`, (Bg,) int32): each row
+    commits at most draft_limit + 1 tokens per round — the exact same
+    truncation the stop_len cap performs, so a row at limit 0 is plain
+    decode riding the speculative window (its one committed token is
+    the draft if accepted else the corrective: the marginal is the
+    target distribution either way, and at temperature 0 it is THE
+    greedy token). The dispatch still drafts/verifies n_drafts
+    positions for every row; the host drops n_drafts to 0 (the plain
+    program) once every live slot is off (spec_control.py).
+
+    Seeded requests (`use_rows`): the draft-model proposal, accept
+    uniform, and corrective draws are POSITION-KEYED on tagged streams
+    of the request's seed (speculative._row_pos_keys), so at a fixed
+    draft length a seeded speculative stream is identical under both
+    schedulers, and commit truncation (stop_len / draft_limit) replays
+    transparently. Mid-stream LENGTH changes keep distributional
+    exactness but not draw-for-draw replay at temperature > 0 (see
+    speculative.py's stream-tag note); greedy is exact throughout.
 
     Returns (state', lengths', last',
     (toks (R, Bg, G+1), lps (R, Bg, G+1), counts (R, Bg))).
@@ -528,7 +568,14 @@ def _spec_core(params, state, lengths, tables, last_token, live,
                         allowed_mask=dmask)
                 else:
                     qp = sampling_probs(dlogits, infer_cfg)
-                nxt = sample_from_probs(qp, rng_d)
+                if use_rows:
+                    # position-keyed proposal stream: schedule- and
+                    # draft-length-invariant for seeded requests
+                    dkeys = _row_pos_keys(samp_rows.seed,
+                                          lengths + 1 + off, _TAG_DRAFT)
+                    nxt = sample_from_probs_keyed(qp, dkeys)
+                else:
+                    nxt = sample_from_probs(qp, rng_d)
                 return _split_cache(dcache), (nxt, qp)
 
             # inputs step j: the token at position lengths + j; step 0
@@ -600,16 +647,24 @@ def _spec_core(params, state, lengths, tables, last_token, live,
                 allowed_mask=amask_w)
         else:
             p_probs = sampling_probs(vlogits, infer_cfg)  # (B, G+1, V)
+        seeds = samp_rows.seed if use_rows else None
+        pos0 = (lengths + 1) if use_rows else None
         if use_draft:
-            n_acc, x = _accept_drafts(drafts, q_probs, p_probs, rng_acc)
+            n_acc, x = _accept_drafts(drafts, q_probs, p_probs, rng_acc,
+                                      seeds=seeds, pos0=pos0)
         else:
-            n_acc, x = _accept_point_mass(drafts, p_probs, rng_acc)
+            n_acc, x = _accept_point_mass(drafts, p_probs, rng_acc,
+                                          seeds=seeds, pos0=pos0)
 
         drafts_x = jnp.concatenate([drafts, x[:, None]], axis=1)
         committed = jnp.where(j < n_acc[:, None], drafts_x,
                               jnp.where(j == n_acc[:, None],
                                         x[:, None], pad))
         count = jnp.where(can_commit, n_acc + 1, 0)
+        if draft_limit is not None:
+            # adaptive per-slot draft length (see docstring): the same
+            # exact truncation as the stop_len cap below
+            count = jnp.minimum(count, draft_limit + 1)
         count = jnp.minimum(count, jnp.maximum(stop_len - lengths, 0))
         toks = jnp.where(j < count[:, None], committed, pad)
         # log P(tok) under the raw target distribution at each window
@@ -664,7 +719,7 @@ _spec_rounds = partial(jax.jit,
 
 @partial(jax.jit,
          static_argnames=("cfg", "infer_cfg", "n_rounds", "n_drafts",
-                          "scatter_prompt", "mesh",
+                          "scatter_prompt", "mesh", "draft_cfg",
                           "use_rows_p", "use_bias_p",
                           "use_rows_d", "use_bias_d"),
          donate_argnums=(1,))
@@ -673,10 +728,12 @@ def _mixed_step(params, state,
                 prompt_rows, prompt_lens, samp_rows_g, orig_lens,
                 count_mask, scatter_mask, gid_g, gstate0_g,
                 lengths, tables, last_token, live, stop_len,
-                samp_rows_b, gid_b, slot_ids_d,
-                rng, grammar=None, lora=None, aid_g=None, aid_b=None, *,
+                samp_rows_b, gid_b, slot_ids_d, draft_limit,
+                rng, grammar=None, lora=None, aid_g=None, aid_b=None,
+                draft_params=None, *,
                 cfg: ModelConfig, infer_cfg: InferConfig, n_rounds: int,
                 n_drafts: int, scatter_prompt: bool, mesh=None,
+                draft_cfg=None,
                 use_rows_p: bool = False, use_bias_p: bool = False,
                 use_rows_d: bool = False, use_bias_d: bool = False):
     """ONE token-budget mixed iteration, ONE jitted program, ONE host
@@ -685,6 +742,15 @@ def _mixed_step(params, state,
     `widths`/`scatter_mask`) followed by the full multi-round decode
     dispatch (`_decode_plain_core` / `_spec_core`, n_rounds of W = 1 or
     drafts + 1).
+
+    DRAFT-MODEL speculation is fused too (`draft_params`/`draft_cfg`):
+    the draft model's chunk prefill rides inside `_prefill_core`
+    (ragged widths included) and its per-round G+1 decode discipline
+    rides inside `_spec_core`, so the fastest decode path keeps
+    stall-free batching instead of forcing the alternating scheduler.
+    Draft rounds are funded as decode rows under the token budget — a
+    live slot's decode claim is window = n_drafts + 1 tokens per round,
+    charged against prefill funding by the host's budget split.
 
     This is what "fused" means here and why it is stall-free WITHOUT
     extra compute: the alternating scheduler pays one host round trip
@@ -712,10 +778,10 @@ def _mixed_step(params, state,
     state, ptoks, plps = _prefill_core(
         params, state, chunk, g_lens, g_tables, sample_at, slot_ids,
         prompt_rows, prompt_lens, rng_p, samp_rows_g, orig_lens,
-        count_mask, gid_g, gstate0_g, grammar, lora, aid_g, None,
-        widths, scatter_mask,
+        count_mask, gid_g, gstate0_g, grammar, lora, aid_g,
+        draft_params, widths, scatter_mask,
         cfg=cfg, infer_cfg=infer_cfg, scatter_prompt=scatter_prompt,
-        mesh=mesh, draft_cfg=None, use_rows=use_rows_p,
+        mesh=mesh, draft_cfg=draft_cfg, use_rows=use_rows_p,
         use_bias=use_bias_p)
     s = n_drafts + 1
     if n_rounds == 0:
@@ -727,11 +793,11 @@ def _mixed_step(params, state,
     if n_drafts > 0:
         state, lengths, last, out = _spec_core(
             params, state, lengths, tables, last_token, live, stop_len,
-            rng_d, samp_rows_b, gid_b, grammar, lora, aid_b, None,
-            slot_ids_d,
+            rng_d, samp_rows_b, gid_b, grammar, lora, aid_b,
+            draft_params, slot_ids_d, draft_limit,
             cfg=cfg, infer_cfg=infer_cfg, n_rounds=n_rounds,
-            n_drafts=n_drafts, mesh=mesh, use_rows=use_rows_d,
-            use_bias=use_bias_d)
+            n_drafts=n_drafts, mesh=mesh, draft_cfg=draft_cfg,
+            use_rows=use_rows_d, use_bias=use_bias_d)
     else:
         state, lengths, last, (dtoks, dlps, dcnts) = _decode_plain_core(
             params, state, lengths, tables, last_token, live, rng_d,
@@ -803,7 +869,7 @@ class PagedInferenceServer:
                  mixed_token_budget: int | None = None,
                  metrics: ServingMetrics | None = None,
                  flight_recorder_size: int | None = None,
-                 qos=None, tracing=None, slo=None):
+                 qos=None, tracing=None, slo=None, spec_control=None):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -984,6 +1050,20 @@ class PagedInferenceServer:
         # round (mean accepted length + 1); plain decode reports ~1.0
         self.decode_rounds = 0
         self.decode_tokens_committed = 0
+        # speculation accounting: tokens drafted on committing rows'
+        # behalf (each row's own draft length per round) vs the drafts
+        # that actually committed — the wasted-work ledger the adaptive
+        # controller and the per-tenant QoS counters read from
+        self.spec_tokens_drafted = 0
+        self.spec_tokens_accepted = 0
+        # adaptive draft-length control (inference/spec_control.py):
+        # host-side, fed by the per-round counts the scheduler syncs
+        # anyway — zero extra dispatches or syncs (regression-tested).
+        # None = fixed spec_drafts length (spec_control=False / "off",
+        # or no speculation at all)
+        self.spec_control = resolve_controller(
+            spec_control, infer_cfg.spec_control_config, spec_drafts,
+            has_draft_model=draft_cfg is not None)
         self.tokens_emitted = 0  # lifetime emitted tokens (bench/metrics)
         self.preemptions = 0
         self._admit_seq = 0
@@ -1060,14 +1140,15 @@ class PagedInferenceServer:
         #     behind a prefill dispatch and admissions never wait out a
         #     decode dispatch.
         #   "alternating" — the r5 behavior (separate prefill-chunk and
-        #     decode dispatches per step); kept as the fallback, and
-        #     selected automatically for draft-model speculation (the
-        #     draft cache's prefill/decode discipline is not fused yet).
+        #     decode dispatches per step); kept as the fallback. Both
+        #     speculation sources (n-gram AND draft-model) run under
+        #     either scheduler: the draft model's prefill/decode
+        #     discipline is fused into `_mixed_step`.
         sched = scheduler if scheduler is not None else infer_cfg.scheduler
         if sched not in ("mixed", "alternating"):
             raise ValueError(f"unknown scheduler: {sched!r}")
         self.scheduler = sched
-        self._mixed_enabled = sched == "mixed" and draft_cfg is None
+        self._mixed_enabled = sched == "mixed"
         budget = (mixed_token_budget if mixed_token_budget is not None
                   else infer_cfg.mixed_token_budget)
         if budget is None or budget <= 0:
@@ -1379,6 +1460,8 @@ class PagedInferenceServer:
         self._gid[slot_id] = 0
         self._gstate0[slot_id] = 0
         self._aid[slot_id] = 0
+        if self.spec_control is not None:
+            self.spec_control.on_release(slot_id)
         return slot
 
     def _finish(self, slot_id: int) -> None:
@@ -1491,6 +1574,11 @@ class PagedInferenceServer:
                         and req.sampling.needs_penalty_state()):
                     self._ensure_penalty_state()
                 self.orig_len[slot_id] = len(req.prompt)
+                if self.spec_control is not None:
+                    # fresh controller state at the initial draft
+                    # length; a continuation re-prefills the draft
+                    # cache, so any staleness clears with it
+                    self.spec_control.on_admit(slot_id)
                 staged.append(slot_id)
         if not staged:
             return
@@ -1799,6 +1887,55 @@ class PagedInferenceServer:
         return live_ids, sl, live_g, lengths, tables, last, stop, \
             samp, gid, aid
 
+    def _spec_plan(self, live_ids):
+        """Per-iteration speculation plan: (dispatch draft count,
+        per-live-row draft caps). Fixed-length servers (no controller)
+        plan (spec_drafts, None) — the pre-adaptive program, no
+        draft_limit input at all. With the adaptive controller the
+        dispatch width is QUANTIZED to {0, spec_drafts}: per-row caps
+        already bound each slot's commits (and its drafted-token
+        accounting) at its own length, and `n_drafts` is a static
+        shape — one compiled program per distinct value — so
+        intermediate widths would trade a sliver of verify compute for
+        spec_drafts-many extra compiles. All-zero lengths plan
+        (0, None): plain decode, no draft passes at all — the floor
+        adaptive control promises on low-acceptance workloads."""
+        if self.spec_drafts <= 0 or len(live_ids) == 0:
+            return 0, None
+        if self.spec_control is None:
+            return self.spec_drafts, None
+        lens = [self.spec_control.draft_len(int(s)) for s in live_ids]
+        if max(lens) <= 0:
+            return 0, None
+        return self.spec_drafts, lens
+
+    def _pad_limits(self, lens, n_rows: int):
+        """(n_rows,) int32 per-row commit caps from the plan's per-live
+        lengths (padding rows 0 — they never commit anyway)."""
+        lim = np.zeros((n_rows,), np.int32)
+        lim[:len(lens)] = lens
+        return lim
+
+    def _drafted_rows(self, g_iter: int, spec_lens, nl: int):
+        """Per-live-row drafted-token counts for this dispatch's
+        accounting (None = plain decode ran, nothing was drafted)."""
+        if g_iter <= 0:
+            return None
+        return spec_lens if spec_lens is not None else [g_iter] * nl
+
+    def _stage_spec_stats(self, g_iter: int, n_live: int) -> None:
+        """Flight-recorder speculation fields for this iteration:
+        draft rows funded, the dispatch draft count, and (adaptive)
+        the current per-slot draft lengths. Token drafted/accepted
+        fields land post-commit in `_commit_decode_rows`."""
+        if self.spec_drafts <= 0:
+            return
+        st = self._iter_stats
+        st["spec_rows"] = n_live if g_iter > 0 else 0
+        st["spec_window"] = g_iter + 1 if g_iter > 0 else 1
+        if self.spec_control is not None:
+            st["spec_draft_lens"] = self.spec_control.draft_lengths()
+
     def _decode_dispatch(self) -> None:
         n = self._chunk_rounds()
         if self.allocation == "ondemand":
@@ -1811,12 +1948,14 @@ class PagedInferenceServer:
             n = max(1, n)
         (live_ids, sl, live_g, lengths, tables, last_np, stop, samp_g,
          gid_np, aid_np) = self._gather_decode_rows()
+        g_iter, spec_lens = self._spec_plan(live_ids)
         self._iter_stats.update(
             scheduler=self.scheduler, n_live=len(live_ids),
             decode_rounds=n,
-            decode_tokens=len(live_ids) * self.window * n,
+            decode_tokens=len(live_ids) * (g_iter + 1) * n,
             decode_rows=int(live_g.shape[0]),
             compaction_ratio=len(live_ids) / max(int(live_g.shape[0]), 1))
+        self._stage_spec_stats(g_iter, len(live_ids))
         if self.trace_recorder is not None:
             self._stage_decode_spans(live_ids, n)
         args = (jnp.asarray(lengths), jnp.asarray(tables),
@@ -1832,14 +1971,16 @@ class PagedInferenceServer:
         lora = self.adapters.device_args() if use_lora else None
         aid = jnp.asarray(aid_np)
         sl_dev = None if sl is None else jnp.asarray(sl)
-        if self.spec_drafts > 0:
+        if g_iter > 0:
+            lim_dev = (None if spec_lens is None else jnp.asarray(
+                self._pad_limits(spec_lens, int(live_g.shape[0]))))
             self.state, lens, last, (toks, lps, counts) = _spec_rounds(
                 self.params, self.state, *args,
                 jnp.asarray(stop), self._next_rng(), samp,
                 gid, grammar, lora, aid,
-                self.draft_params, sl_dev,
+                self.draft_params, sl_dev, lim_dev,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
-                n_drafts=self.spec_drafts, mesh=self.mesh,
+                n_drafts=g_iter, mesh=self.mesh,
                 draft_cfg=self.draft_cfg, use_rows=use_rows,
                 use_bias=use_bias)
             toks, lps, counts, lens, last = jax.device_get(
@@ -1853,12 +1994,29 @@ class PagedInferenceServer:
             toks, lps, counts, lens, last = jax.device_get(
                 (toks, lps, counts, lens, last))
             toks, lps = toks[:, :, None], lps[:, :, None]
-        self._commit_decode_rows(live_ids, toks, lps, counts, lens, last)
+            if self.spec_drafts > 0 and self.spec_control is not None:
+                # every live slot decoded plainly: draft-model caches
+                # miss these positions (sticky off), n-gram slots
+                # accrue probe credit
+                self.spec_control.on_plain_dispatch(
+                    [int(s) for s in live_ids], n)
+        self._commit_decode_rows(live_ids, toks, lps, counts, lens, last,
+                                 self._drafted_rows(g_iter, spec_lens,
+                                                    len(live_ids)))
 
     def _commit_decode_rows(self, live_ids, toks, lps, counts, lens,
-                            last) -> None:
+                            last, drafted=None) -> None:
         """Scatter a compacted decode dispatch's results back to slots
-        and emit (shared by _decode_dispatch and _mixed_dispatch)."""
+        and emit (shared by _decode_dispatch and _mixed_dispatch).
+
+        `drafted` (per-live-row drafted-token counts, None when no
+        draft rows ran) funds the speculation ledger from numbers the
+        host already has: per committed round, a row drafted its own
+        length and accepted `count - 1` of them. The adaptive
+        controller is fed per round (its feedback signal), the
+        per-tenant wasted-speculation counters once per dispatch —
+        all plain host arithmetic on the synced counts, zero extra
+        device work."""
         nl = len(live_ids)
         lens = np.asarray(lens)
         last = np.asarray(last)
@@ -1867,34 +2025,62 @@ class PagedInferenceServer:
         self.last_token[live_ids] = last[:nl]
         self.decode_rounds += int(counts.shape[0]) * nl
         self.decode_tokens_committed += int(counts.sum())
+        sp_drafted = sp_accepted = 0
+        spec_by_tenant: dict = {}
         for r in range(toks.shape[0]):
             for i, sid in enumerate(live_ids):
                 slot = self._slots[sid]
                 if slot is None or not self.active[sid]:
                     continue
-                for t in range(int(counts[r, i])):
+                c = int(counts[r, i])
+                if drafted is not None and c > 0:
+                    d = int(drafted[i])
+                    a = min(max(c - 1, 0), d)
+                    sp_drafted += d
+                    sp_accepted += a
+                    if self.spec_control is not None:
+                        self.spec_control.observe(sid, d, a)
+                    if self.qos is not None and d > 0:
+                        dd, aa = spec_by_tenant.get(slot.req.tenant,
+                                                    (0, 0))
+                        spec_by_tenant[slot.req.tenant] = (dd + d, aa + a)
+                for t in range(c):
                     if self._emit(slot.req, int(toks[r, i, t]),
                                   float(lps[r, i, t])):
                         self._finish(sid)
                         break
+        if drafted is not None:
+            self.spec_tokens_drafted += sp_drafted
+            self.spec_tokens_accepted += sp_accepted
+            st = self._iter_stats
+            st["spec_tokens_drafted"] = (
+                st.get("spec_tokens_drafted", 0) + sp_drafted)
+            st["spec_tokens_accepted"] = (
+                st.get("spec_tokens_accepted", 0) + sp_accepted)
+            for tenant, (dd, aa) in spec_by_tenant.items():
+                self.qos.charge_speculation(tenant, dd, aa)
 
     # -- mixed (stall-free) scheduling --------------------------------------
 
-    def _mixed_rounds(self, n_live: int, prefill_demand: int) -> int:
+    def _mixed_rounds(self, n_live: int, prefill_demand: int,
+                      win: int) -> int:
         """Decode rounds for a mixed iteration: the full steady-state
         count (`_chunk_rounds` WITHOUT the admit shrink — not stalling
         decode is the point), then squeezed to leave the budget at least
         one minimal prefill chunk when admissions are waiting, floored
-        at one round and kept a power of two (compile cache)."""
+        at one round and kept a power of two (compile cache). `win` is
+        THIS iteration's decode window (current max draft length + 1 —
+        adaptive speculation shrinks it with demand), so a slot's
+        decode claim against the budget is its honest token count."""
         rem = [s.req.max_new_tokens - len(s.req.tokens)
                for i, s in enumerate(self._slots)
                if s is not None and self.active[i]]
         if not rem or not n_live:
             return 0
-        n = max(1, min(self.decode_chunk, -(-min(rem) // self.window)))
+        n = max(1, min(self.decode_chunk, -(-min(rem) // win)))
         if prefill_demand > 0:
             fit = (self.mixed_token_budget - self._rem_buckets[0]) \
-                // (n_live * self.window)
+                // (n_live * win)
             n = min(n, max(fit, 1))
         p = 1
         while p * 2 <= n:
@@ -1921,7 +2107,8 @@ class PagedInferenceServer:
         b = self.max_slots
         demand = sum(int(j.rem_lens[0]) - j.done for j in self._jobs)
         n_live = int(self.active.sum())
-        n_rounds = self._mixed_rounds(n_live, demand)
+        g0, _ = self._spec_plan(np.flatnonzero(self.active))
+        n_rounds = self._mixed_rounds(n_live, demand, g0 + 1)
         if self.allocation == "ondemand" and n_rounds > 0:
             n_eff = self._extend_chains(n_rounds)
             if n_eff <= 0 or not self.active.any():
@@ -1932,6 +2119,12 @@ class PagedInferenceServer:
                 n_rounds = max(1, n_rounds)
         live = self.active if n_rounds > 0 else np.zeros((b,), bool)
         n_live = int(live.sum())
+        # authoritative speculation plan for the dispatch (re-planned:
+        # _extend_chains may have preempted a slot out of the live set);
+        # draft rounds are funded as decode rows — a slot's claim is
+        # `win` tokens per round, charged against prefill funding below
+        g_iter, spec_lens = self._spec_plan(np.flatnonzero(self.active))
+        win = g_iter + 1
 
         jobs = self._jobs
         if self.qos is not None and jobs:
@@ -1947,7 +2140,7 @@ class PagedInferenceServer:
                 [self._slots[j.slots[0]].req.tenant for j in jobs])
             jobs = [self._jobs[i] for i in order]
         sel: list[tuple[_AdmitJob, int]] = []
-        left = self.mixed_token_budget - n_live * self.window * n_rounds
+        left = self.mixed_token_budget - n_live * win * n_rounds
         for job in jobs:
             if left <= 0:
                 break
@@ -1970,8 +2163,10 @@ class PagedInferenceServer:
                     self._slots[job.slots[0]].req.tenant, take)
         self._iter_stats.update(
             scheduler="mixed", n_live=n_live, decode_rounds=n_rounds,
-            decode_tokens=n_live * self.window * n_rounds,
+            decode_tokens=n_live * win * n_rounds,
             prefill_tokens=sum(t for _, t in sel))
+        if n_rounds > 0:
+            self._stage_spec_stats(g_iter, n_live)
         if self.trace_recorder is not None:
             for job, take in sel:
                 r = self._slots[job.slots[0]].req
@@ -2069,21 +2264,31 @@ class PagedInferenceServer:
                 jax.tree.map(jnp.asarray, samp_d),
                 jnp.asarray(gid_d),
                 None if sl_d is None else jnp.asarray(sl_d),
+                None if spec_lens is None else jnp.asarray(
+                    self._pad_limits(spec_lens, int(live_g.shape[0]))),
                 self._next_rng(),
                 self._grammar_dev if use_grammar else None,
                 self.adapters.device_args() if use_lora else None,
                 jnp.asarray(aid_g), jnp.asarray(aid_d),
+                self.draft_params,
                 cfg=self.cfg, infer_cfg=self.infer_cfg,
-                n_rounds=n_rounds, n_drafts=self.spec_drafts,
+                n_rounds=n_rounds, n_drafts=g_iter,
                 scatter_prompt=bool(scatm.any()), mesh=self.mesh,
+                draft_cfg=self.draft_cfg,
                 use_rows_p=use_rows_p, use_bias_p=use_bias_p,
                 use_rows_d=use_rows_d, use_bias_d=use_bias_d)
         ptoks, plps, toks, lps, counts, lens, last = jax.device_get(
             (ptoks, plps, toks, lps, counts, lens, last))
 
         if n_rounds > 0:
+            if (g_iter == 0 and self.spec_drafts > 0
+                    and self.spec_control is not None):
+                self.spec_control.on_plain_dispatch(
+                    [int(s) for s in live_ids], n_rounds)
             self._commit_decode_rows(live_ids, np.asarray(toks),
-                                     np.asarray(lps), counts, lens, last)
+                                     np.asarray(lps), counts, lens, last,
+                                     self._drafted_rows(g_iter, spec_lens,
+                                                        len(live_ids)))
 
         # prefill progress: capture first tokens, activate completed
         # admissions (mirrors _run_one_chunk's completion block)
@@ -2239,6 +2444,20 @@ class PagedInferenceServer:
         reg.counter("preemptions_total",
                     "Lifetime on-demand-paging preemptions").set_total(
                         self.preemptions)
+        reg.counter("spec_tokens_drafted_total",
+                    "Draft tokens proposed on committing rows' behalf"
+                    ).set_total(self.spec_tokens_drafted)
+        reg.counter("spec_tokens_accepted_total",
+                    "Draft tokens accepted and committed"
+                    ).set_total(self.spec_tokens_accepted)
+        rate = (self.spec_control.accept_rate()
+                if self.spec_control is not None else
+                self.spec_tokens_accepted
+                / max(self.spec_tokens_drafted, 1))
+        reg.gauge("spec_accept_rate",
+                  "Rolling speculative accept rate (accepted/drafted "
+                  "per committed round; lifetime ratio without the "
+                  "adaptive controller)").set(rate)
         stats = self.allocator.stats()
         reg.gauge("pages_total",
                   "KV page pool size").set(stats.pages_total)
@@ -2269,6 +2488,31 @@ class PagedInferenceServer:
         and /stats source; ReplicatedRouter merges these across
         replicas)."""
         return self.metrics.registry.snapshot()
+
+    def speculation_stats(self) -> dict:
+        """The /stats `speculation` summary. Counts are fleet-mergeable
+        (ReplicatedRouter sums them and recomputes `accept_rate` from
+        the merged totals, like `tenant_fair_share`); `draft_lens` is
+        this server's live per-slot view and is dropped by the fleet
+        merge."""
+        out = {
+            "enabled": self.spec_drafts > 0,
+            "source": ("off" if self.spec_drafts <= 0 else
+                       "draft_model" if self.draft_cfg is not None
+                       else "ngram"),
+            "max_drafts": self.spec_drafts,
+            "adaptive": self.spec_control is not None,
+            "tokens_drafted": self.spec_tokens_drafted,
+            "tokens_accepted": self.spec_tokens_accepted,
+            "accept_rate": (self.spec_tokens_accepted
+                            / max(self.spec_tokens_drafted, 1)),
+        }
+        if self.spec_control is not None:
+            out["rolling_accept_rate"] = self.spec_control.accept_rate()
+            out["draft_lens"] = {
+                str(k): v
+                for k, v in self.spec_control.draft_lengths().items()}
+        return out
 
     @property
     def ready(self) -> bool:

@@ -222,6 +222,12 @@ class _TenantState:
         self.generated = 0
         self.preempt_requeues = 0
         self.prefill_tokens = 0
+        # speculative decoding: draft tokens proposed on the tenant's
+        # rows vs accepted-and-committed. Only COMMITTED tokens are
+        # billed to the generated bucket (charge_generated); the
+        # difference is the tenant's wasted-speculation ledger
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
 
 class TenantRegistry:
@@ -542,6 +548,19 @@ class TenantRegistry:
             if st.generated_bucket is not None:
                 st.generated_bucket.charge(n, now)
 
+    def charge_speculation(self, tenant: str | None, drafted: int,
+                           accepted: int) -> None:
+        """Account one dispatch's speculative work for the tenant:
+        `drafted` tokens were proposed on its rows, `accepted` of them
+        committed. The generated-token BUCKET is untouched — committed
+        tokens were already billed one by one via charge_generated —
+        this only feeds the wasted-speculation ledger (drafted -
+        accepted) the scrape-path mirrors and the fleet merge report."""
+        st = self._state(self.resolve(tenant))
+        with self._lock:
+            st.spec_drafted += drafted
+            st.spec_accepted += accepted
+
     # -- scrape-path views --------------------------------------------------
 
     def tenants(self) -> list[str]:
@@ -577,6 +596,9 @@ class TenantRegistry:
                     "generated": st.generated,
                     "preempt_requeues": st.preempt_requeues,
                     "prefill_tokens": st.prefill_tokens,
+                    "spec_drafted": st.spec_drafted,
+                    "spec_accepted": st.spec_accepted,
+                    "spec_wasted": st.spec_drafted - st.spec_accepted,
                     "fair_share": shares[name],
                 }
             return out
@@ -611,6 +633,12 @@ class TenantRegistry:
                 "tenant_preempt_requeues_total",
                 "Preempt-requeues charged to the tenant's slots",
                 labels=lbl).set_total(s["preempt_requeues"])
+            registry.counter(
+                "tenant_spec_wasted_tokens_total",
+                "Rejected speculative draft work on the tenant's rows "
+                "(drafted - accepted; committed tokens are billed to "
+                "the generated bucket, this is the waste ledger)",
+                labels=lbl).set_total(s["spec_wasted"])
             registry.gauge(
                 "tenant_pending_requests",
                 "Queued requests awaiting admission, per tenant",

@@ -203,6 +203,13 @@ class ReplicatedRouter:
             t = (entry.get("labels") or {}).get("tenant")
             if t in tstats:
                 entry["value"] = tstats[t]["fair_share"]
+        # spec_accept_rate is a RATIO gauge too: recompute from the
+        # fleet-merged drafted/accepted totals, never by adding the
+        # per-replica rates
+        if "cloud_server_spec_accept_rate" in merged:
+            sstats = self.speculation_stats()
+            merged["cloud_server_spec_accept_rate"]["value"] = (
+                sstats.get("accept_rate", 0.0))
         # same rule for the SLO ratio gauges: attainment/burn recompute
         # from the fleet-merged good/total counts, never by adding the
         # per-replica ratios (two 0.99-attaining replicas must read
@@ -252,10 +259,12 @@ class ReplicatedRouter:
                     "weight": s["weight"], "priority": s["priority"],
                     "pending": 0, "submitted": 0, "rejected": 0,
                     "generated": 0, "preempt_requeues": 0,
-                    "prefill_tokens": 0})
+                    "prefill_tokens": 0, "spec_drafted": 0,
+                    "spec_accepted": 0, "spec_wasted": 0})
                 for k in ("pending", "submitted", "rejected",
                           "generated", "preempt_requeues",
-                          "prefill_tokens"):
+                          "prefill_tokens", "spec_drafted",
+                          "spec_accepted", "spec_wasted"):
                     cur[k] += s[k]
         from cloud_server_tpu.inference.qos import compute_fair_shares
         shares = compute_fair_shares(
@@ -263,6 +272,41 @@ class ReplicatedRouter:
              for name, s in merged.items()})
         for name, s in merged.items():
             s["fair_share"] = shares[name]
+        return merged
+
+    def speculation_stats(self) -> dict:
+        """FLEET-wide speculation summary (the /stats `speculation`
+        source behind the router): drafted/accepted counts sum across
+        replicas and `accept_rate` recomputes from the merged totals
+        (a per-replica ratio would not average meaningfully —
+        exactly the `tenant_fair_share` rule). Per-replica live
+        `draft_lens` views are dropped (slot ids are replica-local)."""
+        merged: dict = {}
+        for r in self.replicas:
+            fn = getattr(r, "speculation_stats", None)
+            if fn is None:
+                continue
+            s = fn()
+            if not merged:
+                merged = {
+                    "enabled": s["enabled"], "source": s["source"],
+                    "max_drafts": s["max_drafts"],
+                    "adaptive": s["adaptive"],
+                    "tokens_drafted": 0, "tokens_accepted": 0}
+            elif s["enabled"] and not merged["enabled"]:
+                # heterogeneous fleet: config metadata must come from a
+                # replica that actually speculates, not whichever
+                # answered first — otherwise /stats could report
+                # source "off" alongside nonzero drafted counts
+                merged.update(source=s["source"],
+                              max_drafts=s["max_drafts"],
+                              adaptive=s["adaptive"])
+            merged["enabled"] = merged["enabled"] or s["enabled"]
+            merged["tokens_drafted"] += s["tokens_drafted"]
+            merged["tokens_accepted"] += s["tokens_accepted"]
+        if merged:
+            merged["accept_rate"] = (merged["tokens_accepted"]
+                                     / max(merged["tokens_drafted"], 1))
         return merged
 
     def lookup_trace(self, request_id: str) -> dict | None:

@@ -59,13 +59,79 @@ from cloud_server_tpu.inference.engine import (
 from cloud_server_tpu.inference.sampling import (
     sample_from_probs, sampling_probs)
 
+# Per-request POSITION-KEYED speculative draws (the paged server's
+# use_rows path): seeded requests promise "draws depend only on (seed,
+# position), never on batch composition or schedule"
+# (sampling._row_keys), and the speculative rule consumes three draw
+# streams of its own — the draft model's proposal, the accept uniform,
+# and the corrective/bonus sample, all at a definite absolute sequence
+# position. Folding a stream tag on top of the same (seed, position)
+# key keeps every stream independent of the others AND of the plain
+# token-sampling draw (which uses the untagged key), so at a FIXED
+# per-round draft length a seeded request's speculative stream is
+# identical under any scheduler, and commit TRUNCATION (stop_len /
+# draft_limit caps) replays transparently — the unconsumed positions
+# re-draw the same values next round from the same prefix. Changing
+# the draft length itself mid-stream (the adaptive controller) is NOT
+# draw-invariant at temperature > 0: a position that falls on one
+# schedule's all-accepted bonus draw is another schedule's draft +
+# accept, so adaptive seeded runs stay exact in DISTRIBUTION but are
+# reproducible only per length schedule (greedy is always exact).
+_TAG_DRAFT, _TAG_ACCEPT, _TAG_RESIDUAL = 101, 102, 103
 
-def _accept_drafts(drafts, q_probs, p_probs, rng):
+
+def _row_pos_keys(seeds, positions, tag: int):
+    """(N,) uint32 seeds + (N,) int32 absolute positions -> (N,) keys
+    on the `tag` stream (disjoint from token sampling's untagged
+    fold_in(key(seed), position))."""
+    def mk(seed, pos):
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.key(seed), pos), tag)
+
+    return jax.vmap(mk)(seeds, positions)
+
+
+def sample_from_probs_keyed(probs, keys):
+    """Per-row categorical draw: (B, V) probabilities with (B,) keys
+    -> (B,) int32 (the keyed counterpart of sample_from_probs)."""
+    return jax.vmap(
+        lambda k, p: jax.random.categorical(
+            k, jnp.log(jnp.maximum(p, 1e-30))))(keys, probs).astype(
+                jnp.int32)
+
+
+def _accept_uniforms(rng_u, b: int, g: int, seeds, pos0):
+    """The (B, G) accept uniforms: dispatch-rng (seeds None) or
+    position-keyed per row on the _TAG_ACCEPT stream (u for drafts[:,
+    j] keyed at the draft's absolute position pos0 + j)."""
+    if seeds is None:
+        return jax.random.uniform(rng_u, (b, g))
+    upos = (pos0[:, None] + jnp.arange(g)[None, :]).reshape(-1)
+    keys = _row_pos_keys(jnp.repeat(seeds, g), upos, _TAG_ACCEPT)
+    return jax.vmap(
+        lambda k: jax.random.uniform(k, ()))(keys).reshape(b, g)
+
+
+def _residual_draw(rng_x, residual, n_acc, seeds, pos0):
+    """The corrective/bonus draw: dispatch-rng, or keyed at the
+    corrective's absolute position (pos0 + n_acc) on _TAG_RESIDUAL."""
+    if seeds is None:
+        return sample_from_probs(residual, rng_x)
+    keys = _row_pos_keys(seeds, pos0 + n_acc, _TAG_RESIDUAL)
+    return sample_from_probs_keyed(residual, keys)
+
+
+def _accept_drafts(drafts, q_probs, p_probs, rng, *, seeds=None,
+                   pos0=None):
     """Vectorised accept/residual rule.
 
     drafts: (B, G) proposed tokens; q_probs: (B, G, V) draft sampling
     distributions; p_probs: (B, G+1, V) target sampling distributions
     (position j scores drafts[:, j]; position G is the bonus position).
+    `seeds`/`pos0` ((B,) uint32 / (B,) int32 absolute position of
+    drafts[:, 0]) switch the u and corrective draws to the per-request
+    position-keyed streams (see _TAG_* above); None keeps the
+    dispatch-rng draws.
 
     Returns (n_accepted (B,) int32 in [0, G], corrective token x (B,)).
     """
@@ -76,7 +142,7 @@ def _accept_drafts(drafts, q_probs, p_probs, rng):
     q_d = jnp.take_along_axis(q_probs, drafts[..., None], axis=-1)[..., 0]
     p_d = jnp.take_along_axis(p_probs[:, :g], drafts[..., None],
                               axis=-1)[..., 0]
-    u = jax.random.uniform(rng_u, (b, g))
+    u = _accept_uniforms(rng_u, b, g, seeds, pos0)
     accept = u * jnp.maximum(q_d, 1e-30) < p_d  # u < min(1, p/q)
     prefix = jnp.cumprod(accept.astype(jnp.int32), axis=-1)
     n_acc = prefix.sum(axis=-1)  # (B,) in [0, G]
@@ -92,15 +158,17 @@ def _accept_drafts(drafts, q_probs, p_probs, rng):
     # If float round-off leaves residual empty, fall back to p itself.
     bad = residual.sum(-1, keepdims=True) <= 0.0
     residual = jnp.where(bad, p_r, residual)
-    x = sample_from_probs(residual, rng_x)
+    x = _residual_draw(rng_x, residual, n_acc, seeds, pos0)
     return n_acc, x
 
 
-def _accept_point_mass(drafts, p_probs, rng):
+def _accept_point_mass(drafts, p_probs, rng, *, seeds=None, pos0=None):
     """`_accept_drafts` specialised to point-mass q (n-gram drafting):
     q(d) = 1, so acceptance is `u < p(d)` and the residual is p with the
     rejected proposal's index zeroed — computed directly, without
     materialising the (B, G, V) one-hot q tensor in the hot decode loop.
+    `seeds`/`pos0` select the position-keyed draw streams as in
+    `_accept_drafts`.
     """
     b, g = drafts.shape
     rng_u, rng_x = jax.random.split(rng)
@@ -108,7 +176,7 @@ def _accept_point_mass(drafts, p_probs, rng):
 
     p_d = jnp.take_along_axis(p_probs[:, :g], drafts[..., None],
                               axis=-1)[..., 0]
-    u = jax.random.uniform(rng_u, (b, g))
+    u = _accept_uniforms(rng_u, b, g, seeds, pos0)
     prefix = jnp.cumprod((u < p_d).astype(jnp.int32), axis=-1)
     n_acc = prefix.sum(axis=-1)
 
@@ -120,7 +188,7 @@ def _accept_point_mass(drafts, p_probs, rng):
         & (n_acc < g)[:, None], 0.0, p_r)
     bad = residual.sum(-1, keepdims=True) <= 0.0
     residual = jnp.where(bad, p_r, residual)
-    x = sample_from_probs(residual, rng_x)
+    x = _residual_draw(rng_x, residual, n_acc, seeds, pos0)
     return n_acc, x
 
 

@@ -25,9 +25,13 @@
 #
 # Group membership is by filename glob, so new test files land
 # automatically: tests/test_qos.py (multi-tenant QoS) rides the [p-r]
-# group with the other serving-stack heavies, and tests/test_analysis.py
+# group with the other serving-stack heavies,
+# tests/test_spec_control.py (adaptive speculation: controller law,
+# the mixed+draft-spec+adaptive dispatch-count clone, /stats merge)
+# rides [s-z] with test_speculative.py, and tests/test_analysis.py
 # (the stdlib-only hot-path lint gate over inference/qos.py +
-# serving_metrics.py) rides [a-f]. The lint is also runnable standalone:
+# inference/spec_control.py + serving_metrics.py) rides [a-f]. The
+# lint is also runnable standalone:
 #   python -m cloud_server_tpu.analysis
 MARK=(-m "not slow")
 if [ "$1" = "--all" ]; then

@@ -68,6 +68,39 @@ def test_checker_flags_bad_trace_and_slo_paths():
                             ("BadSLO.observe_fine",))
 
 
+def test_registry_covers_spec_control():
+    """The adaptive-speculation controller runs inside the scheduler
+    iteration (planning per dispatch, feedback per committed round) —
+    its hot surface must stay on the scan roster."""
+    quals = set(HOT_PATHS["cloud_server_tpu/inference/spec_control.py"])
+    for needed in ("SpecController.draft_len",
+                   "SpecController.observe",
+                   "SpecController.on_plain_dispatch",
+                   "SpecController.draft_lengths"):
+        assert needed in quals, f"{needed} dropped from HOT_PATHS"
+    qos_quals = set(HOT_PATHS["cloud_server_tpu/inference/qos.py"])
+    assert "TenantRegistry.charge_speculation" in qos_quals
+
+
+def test_checker_flags_bad_spec_control_paths():
+    """Fixture round-trip for the spec-control roster: device work in
+    dispatch planning, numpy buffers per observed round, wall-clock
+    rate decay, logging and I/O — each violation class must fire."""
+    src = (_FIXTURES / "hot_path_spec_bad.py").read_text()
+    cases = {
+        "BadSpecController.draft_len_device": "device",
+        "BadSpecController.observe_numpy": "numpy",
+        "BadSpecController.accept_rate_wall_clock": "time.time",
+        "BadSpecController.observe_logged": "logging",
+        "BadSpecController.on_plain_dispatch_io": "I/O",
+    }
+    for qual, needle in cases.items():
+        findings = check_source("hot_path_spec_bad.py", src, (qual,))
+        assert findings, f"{qual}: expected a finding"
+        assert any(needle in f.message for f in findings), \
+            f"{qual}: {[str(f) for f in findings]}"
+
+
 def test_checker_accepts_clean_fixture():
     src = (_FIXTURES / "hot_path_good.py").read_text()
     findings = check_source("hot_path_good.py", src,

@@ -219,6 +219,154 @@ def test_mixed_grammar_and_penalties_through_admission(params):
     assert run(srv) == run(alt)
 
 
+def _draft_setup():
+    draft_cfg = dataclasses.replace(CFG, embed_dim=16, num_layers=1,
+                                    num_heads=2, num_kv_heads=2,
+                                    mlp_dim=32)
+    draft_params = transformer.init_params(draft_cfg, jax.random.key(9))
+    return draft_params, draft_cfg
+
+
+REP = [3, 4, 5, 6] * 5 + [3, 4]  # drafts genuinely accept here
+
+
+def test_mixed_draft_spec_greedy_equals_alternating(params):
+    """THE fusion property: with a draft model configured the mixed
+    scheduler STAYS mixed (it used to force alternating), and greedy
+    outputs are token-for-token identical to alternating+spec and the
+    engine reference — admissions landing mid-decode, draft prefill
+    riding the ragged fused group."""
+    draft_params, draft_cfg = _draft_setup()
+    kw = dict(spec_drafts=2, draft_params=draft_params,
+              draft_cfg=draft_cfg, **SRV_KW)
+    prompts = [REP, PROMPTS[0], LONG, list(range(1, 14))]
+    mixed = PagedInferenceServer(params, CFG, GREEDY, scheduler="mixed",
+                                 **kw)
+    assert mixed._mixed_enabled, \
+        "draft-model speculation must not force the alternating scheduler"
+    alt = PagedInferenceServer(params, CFG, GREEDY,
+                               scheduler="alternating", **kw)
+    out_m = _staggered_run(mixed, prompts, 12)
+    out_a = _staggered_run(alt, prompts, 12)
+    assert out_m == out_a
+    for p, o in zip(prompts, out_m):
+        assert o == _engine_reference(params, p, 12), p
+
+
+def test_mixed_draft_spec_seeded_equals_alternating(params):
+    """Seeded sampling through draft-model speculation: the draft
+    proposal, accept uniform, and corrective draws are position-keyed
+    per request (speculative._row_pos_keys), so the schedule must not
+    change speculative sampled outputs either — mixed and alternating
+    agree token-for-token at temperature > 0, penalties included.
+    Draft length pinned (spec_control=False): length schedules are a
+    throughput policy, and at temperature > 0 the bonus-position draw
+    legitimately differs across schedules that pick different
+    lengths."""
+    draft_params, draft_cfg = _draft_setup()
+    icfg = dataclasses.replace(GREEDY, temperature=1.0)
+    sp = [SamplingParams(seed=300 + i, temperature=0.9, top_p=0.9,
+                         presence_penalty=0.3)
+          for i in range(4)]
+    prompts = [REP, PROMPTS[0], LONG, PROMPTS[1]]
+
+    def run(sched):
+        srv = PagedInferenceServer(
+            params, CFG, icfg, scheduler=sched, spec_drafts=2,
+            draft_params=draft_params, draft_cfg=draft_cfg,
+            spec_control=False, **SRV_KW)
+        reqs = [srv.submit(p, max_new_tokens=10, sampling=s)
+                for p, s in zip(prompts[:2], sp[:2])]
+        for _ in range(3):
+            srv.step()
+        reqs += [srv.submit(p, max_new_tokens=10, sampling=s)
+                 for p, s in zip(prompts[2:], sp[2:])]
+        srv.run_until_idle()
+        return [r.result() for r in reqs]
+
+    assert run("mixed") == run("alternating")
+
+
+def test_mixed_adaptive_spec_midstream_changes_exact(params):
+    """Mid-stream draft-length changes from the controller keep greedy
+    outputs exact: a random-init draft model accepts poorly, so an
+    aggressive controller really does walk lengths down (and 0-length
+    rows ride the speculative window as plain decode) — and every
+    token still matches the engine reference and alternating+adaptive."""
+    draft_params, draft_cfg = _draft_setup()
+    ctl = {"low": 0.45, "high": 0.8, "ewma": 0.5, "cooldown": 1,
+           "probe_period": 4}
+    kw = dict(spec_drafts=3, draft_params=draft_params,
+              draft_cfg=draft_cfg, spec_control=ctl, **SRV_KW)
+    prompts = [REP, PROMPTS[0], LONG]
+    mixed = PagedInferenceServer(params, CFG, GREEDY, scheduler="mixed",
+                                 **kw)
+    alt = PagedInferenceServer(params, CFG, GREEDY,
+                               scheduler="alternating", **kw)
+    out_m = _staggered_run(mixed, prompts, 14)
+    out_a = _staggered_run(alt, prompts, 14)
+    assert mixed.spec_control.length_changes > 0, \
+        "controller never changed a draft length; the test is vacuous"
+    assert out_m == out_a
+    for p, o in zip(prompts, out_m):
+        assert o == _engine_reference(params, p, 14), p
+
+
+def test_mixed_adaptive_ngram_raises_lengths_exact(params):
+    """The controller moves BOTH ways: n-gram drafting on repetitive
+    prompts accepts well, so lengths climb from a pinned-low start —
+    still token-for-token exact, and committed-per-round really rises
+    above plain decode's 1.0."""
+    ctl = {"initial": 1, "low": 0.2, "high": 0.5, "ewma": 0.5,
+           "cooldown": 2, "probe_period": 8}
+    srv = PagedInferenceServer(params, CFG, GREEDY, scheduler="mixed",
+                               spec_drafts=3, spec_control=ctl, **SRV_KW)
+    prompts = [REP, [3, 4, 5, 6] * 6]
+    out = _staggered_run(srv, prompts, 16)
+    assert srv.spec_control.length_changes > 0
+    assert (srv.decode_tokens_committed / max(srv.decode_rounds, 1)) > 1.1
+    for p, o in zip(prompts, out):
+        assert o == _engine_reference(params, p, 16), p
+
+
+def test_mixed_draft_spec_grammar_equals_alternating():
+    """Grammar masks through the FUSED draft/verify walk: a
+    regex-constrained, penalized request sharing the batch with a free
+    request — mixed+draft-spec == alternating+draft-spec
+    token-for-token, and the constrained output is all digits."""
+    from cloud_server_tpu.data.tokenizer import ByteTokenizer
+    tok = ByteTokenizer()
+    gcfg = dataclasses.replace(CFG, vocab_size=300)
+    gparams = transformer.init_params(gcfg, jax.random.key(2))
+    draft_cfg = dataclasses.replace(gcfg, embed_dim=16, num_layers=1,
+                                    num_heads=2, num_kv_heads=2,
+                                    mlp_dim=32)
+    draft_params = transformer.init_params(draft_cfg, jax.random.key(3))
+    icfg = InferConfig(max_decode_len=12, temperature=0.0,
+                       eos_token_id=tok.eos_id, pad_token_id=0)
+    kw = dict(max_slots=4, max_context=128, page_size=8,
+              prefill_chunk=16, prompt_buckets=[16, 32], tokenizer=tok,
+              spec_drafts=2, draft_params=draft_params,
+              draft_cfg=draft_cfg)
+
+    def run(sched):
+        srv = PagedInferenceServer(gparams, gcfg, icfg, scheduler=sched,
+                                   **kw)
+        free = srv.submit(tok.encode("hello"), max_new_tokens=12)
+        for _ in range(2):
+            srv.step()
+        con = srv.submit(tok.encode("n:"), max_new_tokens=12,
+                         sampling=SamplingParams(regex=r"[0-9]+", seed=5,
+                                                 frequency_penalty=0.3))
+        srv.run_until_idle()
+        return free.result(), con.result()
+
+    out_m = run("mixed")
+    assert out_m == run("alternating")
+    digits = tok.decode([t for t in out_m[1] if t != tok.eos_id])
+    assert digits and digits.isdigit(), digits
+
+
 def test_mixed_rejects_unknown_scheduler(params):
     with pytest.raises(ValueError, match="scheduler"):
         PagedInferenceServer(params, CFG, GREEDY, scheduler="fifo",
