@@ -1,24 +1,44 @@
-"""Static invariant checks for the serving stack's host hot path.
+"""Static invariant checks for the serving stack — a multi-pass,
+stdlib-only analysis framework.
 
-``python -m cloud_server_tpu.analysis`` scans the per-iteration
-scheduler code registered in ``hot_path.HOT_PATHS`` and exits non-zero
-on any finding; the same gate runs as a tier-1 test
-(``tests/test_analysis.py``).
+``python -m cloud_server_tpu.analysis [--json] [--checker <id>]``
+runs every registered pass over the serving stack and exits non-zero
+on any unsuppressed finding; the same gate runs as a tier-1 test
+(``tests/test_analysis.py``) and as an explicit ``run_tests.sh``
+step. Checker ids, rules, and the suppression-pragma syntax are
+cataloged in ``docs/analysis.md`` (drift-checked both ways).
+
+The three passes shipped today:
+
+  * ``hot-path`` (``hot_path.py``) — the per-iteration scheduler code
+    registered in ``HOT_PATHS`` must stay free of device work,
+    blocking transfers, numpy-buffer materialization, wall-clock
+    reads, and host I/O.
+  * ``lock-discipline`` (``locks.py``) — infers each class's
+    guarded-attribute sets from its ``with self._lock:`` /
+    ``with self._step_lock:`` regions and flags unlocked access to
+    shared state, blocking calls while a lock is held, and
+    acquisitions against the declared ``_step_lock -> _lock`` order.
+  * ``dispatch-discipline`` (``dispatch.py``) — ONE sanctioned
+    ``device_get`` per scheduler iteration, jax-free host-policy
+    modules, and statically bounded values into jitted static
+    arguments (the compile-variant invariant).
+
+Deliberate exceptions are carried in the code as
+``# analysis: allow[<checker>] <reason>`` pragmas; the reason is
+mandatory (a reason-less pragma is itself a finding).
 
 Everything here is stdlib-only (ast) and never imports jax, numpy, or
-the serving stack: the gate runs inside every test process, so it must
-be fast and must not spend any of the process's vm.max_map_count
+the serving stack: the gate runs inside every test process, so it
+must be fast and must not spend any of the process's vm.max_map_count
 budget on an XLA backend it never uses.
-
-The one checker shipped today is the HOT-PATH SYNC/ALLOCATION lint
-(``hot_path.py``): the schedulers are engineered around one
-host<->device sync per iteration, and the QoS admission policy
-(``inference/qos.py``) rides INSIDE that iteration — so the functions
-listed in ``HOT_PATHS`` must stay free of device work, blocking
-transfers, numpy-buffer materialization, wall-clock reads, and host
-I/O. The dispatch-count regression tests sample this dynamically on
-one path; the lint enforces it across every registered function.
 """
 
+from cloud_server_tpu.analysis.framework import (  # noqa: F401
+    Finding, Pass, Report, apply_pragmas, collect_pragmas,
+    register_pass, registered_passes, render_text, report_json,
+    run_analysis)
+# importing the pass modules registers them
 from cloud_server_tpu.analysis.hot_path import (  # noqa: F401
-    Finding, HOT_PATHS, check_hot_paths, check_source)
+    HOT_PATHS, check_hot_paths, check_source)
+from cloud_server_tpu.analysis import dispatch, locks  # noqa: F401

@@ -1,24 +1,44 @@
-"""CLI: ``python -m cloud_server_tpu.analysis [repo_root]``.
+"""CLI: ``python -m cloud_server_tpu.analysis [--json]
+[--checker <id>]... [repo_root]``.
 
-Exit status 0 = every registered hot-path function is clean; 1 = at
-least one finding (each printed as ``path:line: [symbol] message``).
+Exit status 0 = every pass is clean (suppressions honored); 1 = at
+least one unsuppressed finding; 2 = bad usage (unknown checker id).
+Text findings go to stderr (``path:line: [checker] [symbol] message``);
+``--json`` writes the stable machine shape to stdout instead.
 """
 
+import argparse
+import json
 import sys
 
-from cloud_server_tpu.analysis.hot_path import check_hot_paths
+from cloud_server_tpu.analysis import (registered_passes, render_text,
+                                       report_json, run_analysis)
 
 
 def main(argv: list[str]) -> int:
-    root = argv[1] if len(argv) > 1 else None
-    findings = check_hot_paths(root)
-    for f in findings:
-        print(f, file=sys.stderr)
-    if findings:
-        print(f"[analysis] {len(findings)} hot-path finding(s)",
-              file=sys.stderr)
-        return 1
-    return 0
+    parser = argparse.ArgumentParser(
+        prog="python -m cloud_server_tpu.analysis",
+        description="Serving-stack static analysis suite.")
+    parser.add_argument("root", nargs="?", default=None,
+                        help="repository root (default: autodetected)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the stable JSON report on stdout")
+    parser.add_argument("--checker", action="append", default=None,
+                        metavar="ID",
+                        help="run only this checker (repeatable); "
+                             f"ids: {sorted(registered_passes())}")
+    args = parser.parse_args(argv[1:])
+    try:
+        report = run_analysis(args.root, checkers=args.checker)
+    except KeyError as exc:
+        print(f"[analysis] {exc.args[0]}", file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump(report_json(report), sys.stdout, indent=2)
+        print()
+    else:
+        print(render_text(report), file=sys.stderr)
+    return 0 if report.ok else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,353 @@
+"""Multi-pass static-analysis framework for the serving stack.
+
+The serving stack's load-bearing invariants — ONE fused dispatch + ONE
+host sync per scheduler iteration, and the `_lock` / `_step_lock`
+discipline that keeps client threads and the scheduler thread off each
+other's state — are enforced at runtime only on the paths the
+regression tests happen to drive. The passes registered here enforce
+them statically, over every registered file, on every test run.
+
+Pieces:
+
+  * ``Finding`` — the one result model every pass emits:
+    ``path:line``, the checker id, the symbol it fired in, and a
+    message. Paths are repo-relative so findings are stable across
+    checkouts.
+  * ``Pass`` / ``register_pass`` — the registry. A pass is a stable
+    checker id, a ``run(root) -> [Finding]`` callable, and a
+    ``roster(root)`` callable naming the repo-relative files it
+    audits (the suppression scanner walks the union of all rosters).
+  * Inline suppressions — ``# analysis: allow[<checker>] <reason>``.
+    The reason is MANDATORY: a reason-less pragma is itself a finding
+    (checker id ``pragma``), so an exception can never be waved
+    through silently. A pragma suppresses findings of that checker on
+    its own line; a pragma on a comment-only line also covers the
+    next line (for statements too long to carry a trailing comment).
+  * ``run_analysis`` — run selected passes, apply suppressions, and
+    return a ``Report``; ``render_text`` / ``report_json`` are the
+    two reporters the CLI (``__main__``) exposes.
+
+Everything here is stdlib-only (ast + re) and never imports jax,
+numpy, or the serving stack: the gate runs inside every test process,
+so it must be fast and must not spend any of the process's
+vm.max_map_count budget on an XLA backend it never uses.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Iterable
+
+# The implicit checker id carried by reason-less-pragma findings. Not
+# a registered pass — it exists only as a finding namespace (and a
+# documented id in docs/analysis.md) and cannot be suppressed.
+PRAGMA_CHECKER = "pragma"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One static-analysis result, shared by every pass."""
+
+    path: str       # repo-relative file
+    line: int
+    checker: str    # stable checker id ("hot-path", "lock-discipline", ...)
+    symbol: str     # qualname / attribute the finding is about ("" if n/a)
+    message: str
+
+    def __str__(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: [{self.checker}]{sym} " \
+               f"{self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Pass:
+    """A registered checker: id must be stable (docs, pragmas, and the
+    ``--checker`` CLI flag all key on it)."""
+
+    id: str
+    title: str                                  # one-line, for docs/CLI
+    run: Callable[[str], list]                  # root -> [Finding]
+    roster: Callable[[str], tuple]              # root -> repo-rel files
+
+
+_REGISTRY: dict[str, Pass] = {}
+
+
+def register_pass(p: Pass) -> Pass:
+    if p.id in _REGISTRY:
+        raise ValueError(f"checker id {p.id!r} registered twice")
+    if p.id == PRAGMA_CHECKER:
+        raise ValueError(f"checker id {PRAGMA_CHECKER!r} is reserved "
+                         "for reason-less-pragma findings")
+    _REGISTRY[p.id] = p
+    return p
+
+
+def registered_passes() -> dict[str, Pass]:
+    """{checker id: Pass}, insertion-ordered (registration order)."""
+    return dict(_REGISTRY)
+
+
+def default_root() -> str:
+    """Repository root (three levels above this file's package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """Dotted name of an expression ('time.time', 'jnp.asarray'), or
+    None for anything that is not a plain attribute chain. The one
+    AST helper every pass leans on."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def collect_functions(tree: ast.AST
+                      ) -> tuple[dict[str, ast.AST], dict[str, int]]:
+    """({qualname: FunctionDef}, {class qualname: lineno}) for a
+    module — the shared collector behind every roster lookup."""
+    found: dict[str, ast.AST] = {}
+    classes: dict[str, int] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                found[prefix + child.name] = child
+                visit(child, prefix + child.name + ".")
+            elif isinstance(child, ast.ClassDef):
+                classes[prefix + child.name] = child.lineno
+                visit(child, prefix + child.name + ".")
+
+    visit(tree, "")
+    return found, classes
+
+
+def read_rostered(root: str, rel: str, checker: str
+                  ) -> tuple[str | None, Finding | None]:
+    """Read one rostered file; a missing/unreadable file is a FINDING
+    (the roster rotted or the root is wrong), never a traceback out
+    of the gating step."""
+    try:
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            return f.read(), None
+    except OSError as exc:
+        return None, Finding(
+            rel, 1, checker, "",
+            f"rostered file cannot be read ({exc.strerror or exc}) — "
+            "moved/renamed? update the roster")
+
+
+def enclosing_class_line(classes: dict[str, int], qual: str) -> int:
+    """Line of the deepest class prefix of `qual` that exists in
+    `classes` ({"A.B": lineno}); 1 when even the class is gone. The
+    shared anchor rule for "registered function not found" findings —
+    the report lands where the rename happened, not at line 1."""
+    parts = qual.split(".")
+    for cut in range(len(parts) - 1, 0, -1):
+        prefix = ".".join(parts[:cut])
+        if prefix in classes:
+            return classes[prefix]
+    return 1
+
+
+# -- inline suppressions ----------------------------------------------------
+
+# `# analysis: allow[<checker>] <mandatory reason>`; several pragmas
+# may share a line (finditer). The id charset matches registered ids.
+_PRAGMA_RE = re.compile(
+    r"#\s*analysis:\s*allow\[([A-Za-z0-9_-]+)\]([^#\n]*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Pragma:
+    """One suppression-pragma occurrence."""
+
+    path: str
+    line: int                 # where the pragma itself sits
+    checker: str
+    reason: str
+    covers: tuple             # finding lines it suppresses
+
+
+def collect_pragmas(path: str, source: str
+                    ) -> tuple[list, list]:
+    """Scan one file for suppression pragmas.
+
+    Returns ``([Pragma, ...], reasonless_findings)``. A pragma on a
+    comment-only line also covers the statement it annotates (the
+    next non-blank, non-comment line); a pragma with no reason text
+    is a ``pragma`` finding and suppresses nothing."""
+    pragmas: list[Pragma] = []
+    bad: list[Finding] = []
+    lines = source.splitlines()
+    for lineno, text in enumerate(lines, start=1):
+        for m in _PRAGMA_RE.finditer(text):
+            checker, reason = m.group(1), m.group(2).strip()
+            if not reason:
+                bad.append(Finding(
+                    path, lineno, PRAGMA_CHECKER, checker,
+                    f"suppression pragma allow[{checker}] without a "
+                    "reason — every exception must say why"))
+                continue
+            covers = [lineno]
+            if text.lstrip().startswith("#"):
+                # comment-only pragma: also covers the statement it
+                # annotates — the next non-blank, non-comment line
+                for j in range(lineno, len(lines)):
+                    nxt = lines[j].strip()
+                    if nxt and not nxt.startswith("#"):
+                        covers.append(j + 1)
+                        break
+            pragmas.append(Pragma(path, lineno, checker, reason,
+                                  tuple(covers)))
+    return pragmas, bad
+
+
+def pragma_lines(pragmas: Iterable) -> dict[int, dict[str, str]]:
+    """{covered line: {checker id: reason}} from Pragma occurrences —
+    the lookup shape ``apply_pragmas`` consumes."""
+    by_line: dict[int, dict[str, str]] = {}
+    for p in pragmas:
+        for ln in p.covers:
+            by_line.setdefault(ln, {})[p.checker] = p.reason
+    return by_line
+
+
+def apply_pragmas(pragmas: dict[int, dict[str, str]],
+                  findings: Iterable[Finding]
+                  ) -> tuple[list[Finding], list[tuple[Finding, str]]]:
+    """Split one file's findings into (kept, [(suppressed, reason)])."""
+    kept: list[Finding] = []
+    suppressed: list[tuple[Finding, str]] = []
+    for f in findings:
+        reason = pragmas.get(f.line, {}).get(f.checker)
+        if reason is None:
+            kept.append(f)
+        else:
+            suppressed.append((f, reason))
+    return kept, suppressed
+
+
+# -- the driver -------------------------------------------------------------
+
+@dataclasses.dataclass
+class Report:
+    """Outcome of one ``run_analysis`` invocation."""
+
+    root: str
+    checkers: tuple[str, ...]
+    findings: list            # unsuppressed Findings (the gate fails on any)
+    suppressed: list          # [(Finding, reason)]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def run_analysis(root: str | None = None,
+                 checkers: Iterable[str] | None = None) -> Report:
+    """Run the selected passes (default: all registered), apply inline
+    suppressions over every rostered file, and fold reason-less
+    pragmas in as findings of the ``pragma`` checker."""
+    root = root if root is not None else default_root()
+    registry = registered_passes()
+    if checkers is None:
+        selected = list(registry.values())
+    else:
+        selected = []
+        for cid in checkers:
+            if cid not in registry:
+                raise KeyError(
+                    f"unknown checker {cid!r}; registered: "
+                    f"{sorted(registry)}")
+            selected.append(registry[cid])
+
+    raw: list[Finding] = []
+    files: set[str] = set()
+    for p in selected:
+        raw.extend(p.run(root))
+        files.update(p.roster(root))
+
+    ran = {p.id for p in selected}
+    findings: list[Finding] = []
+    suppressed: list[tuple[Finding, str]] = []
+    by_file: dict[str, list[Finding]] = {}
+    for f in raw:
+        by_file.setdefault(f.path, []).append(f)
+    for rel in sorted(files | set(by_file)):
+        full = os.path.join(root, rel)
+        try:
+            with open(full, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            # a finding about a missing file still surfaces; there is
+            # just nothing to scan for pragmas
+            findings.extend(by_file.get(rel, []))
+            continue
+        pragmas, bad = collect_pragmas(rel, source)
+        kept, supp = apply_pragmas(pragma_lines(pragmas),
+                                   by_file.get(rel, []))
+        findings.extend(kept)
+        findings.extend(bad)     # reason-less pragmas: unsuppressable
+        suppressed.extend(supp)
+        # stale-suppression rot: a pragma whose checker RAN but that
+        # matched no finding is dead weight that would silently
+        # swallow the next genuine finding landing on its line
+        hit = {(f.line, f.checker) for f, _ in supp}
+        for p in pragmas:
+            if p.checker in ran and not any(
+                    (ln, p.checker) in hit for ln in p.covers):
+                findings.append(Finding(
+                    rel, p.line, PRAGMA_CHECKER, p.checker,
+                    f"suppression pragma allow[{p.checker}] matched "
+                    "no finding — stale; remove it"))
+            elif p.checker not in registry:
+                findings.append(Finding(
+                    rel, p.line, PRAGMA_CHECKER, p.checker,
+                    f"suppression pragma names unknown checker "
+                    f"{p.checker!r}; registered: {sorted(registry)}"))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.checker, f.message))
+    suppressed.sort(key=lambda fr: (fr[0].path, fr[0].line))
+    return Report(root=root, checkers=tuple(p.id for p in selected),
+                  findings=findings, suppressed=suppressed)
+
+
+# -- reporters --------------------------------------------------------------
+
+def render_text(report: Report) -> str:
+    """Human reporter: one finding per line plus a summary tail."""
+    lines = [str(f) for f in report.findings]
+    lines.append(
+        f"[analysis] checkers: {', '.join(report.checkers)} — "
+        f"{len(report.findings)} finding(s), "
+        f"{len(report.suppressed)} suppressed")
+    return "\n".join(lines)
+
+
+def report_json(report: Report) -> dict:
+    """Machine reporter (the ``--json`` CLI shape). STABLE: external
+    tooling consumes this — tests/test_analysis.py pins the keys."""
+    return {
+        "version": 1,
+        "root": report.root,
+        "checkers": list(report.checkers),
+        "counts": {"findings": len(report.findings),
+                   "suppressed": len(report.suppressed)},
+        "findings": [f.to_dict() for f in report.findings],
+        "suppressed": [{**f.to_dict(), "reason": reason}
+                       for f, reason in report.suppressed],
+    }

@@ -35,8 +35,16 @@ cannot silently rot.
 from __future__ import annotations
 
 import ast
-import dataclasses
-import os
+
+from cloud_server_tpu.analysis.framework import (Finding, Pass,
+                                                 collect_functions,
+                                                 default_root,
+                                                 dotted_name,
+                                                 enclosing_class_line,
+                                                 read_rostered,
+                                                 register_pass)
+
+CHECKER = "hot-path"
 
 # (repo-relative file) -> qualnames whose bodies are per-iteration /
 # per-submit hot path. Keep this in sync with the scheduler: anything
@@ -74,6 +82,20 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "SpecController.on_plain_dispatch",
         "SpecController.accept_rate",
         "SpecController.draft_lengths",
+    ),
+    # replica router: _pick/submit run once per request on the client
+    # thread while holding the router lock (a stall here blocks every
+    # concurrent submitter), and the post-merge ratio recomputes
+    # (fair-share / accept-rate / SLO gauges) run on the scrape path
+    # but iterate the whole fleet per call
+    "cloud_server_tpu/inference/router.py": (
+        "ReplicatedRouter._pick",
+        "ReplicatedRouter.submit",
+        "ReplicatedRouter.num_active",
+        "ReplicatedRouter.num_pending",
+        "ReplicatedRouter.metrics_snapshot",
+        "ReplicatedRouter.tenant_stats",
+        "ReplicatedRouter.speculation_stats",
     ),
     "cloud_server_tpu/inference/qos.py": (
         "TokenBucket._refill",
@@ -121,28 +143,7 @@ _IO_CALLS = {"print", "open", "input"}
 _LOG_ROOTS = {"logging", "logger", "log"}
 
 
-@dataclasses.dataclass(frozen=True)
-class Finding:
-    path: str
-    line: int
-    symbol: str
-    message: str
-
-    def __str__(self) -> str:
-        return f"{self.path}:{self.line}: [{self.symbol}] {self.message}"
-
-
-def _dotted(node: ast.AST) -> str | None:
-    """Dotted name of an expression ('time.time', 'jnp.asarray'), or
-    None for anything that is not a plain attribute chain."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
+_dotted = dotted_name
 
 
 def _check_function(path: str, qual: str,
@@ -151,7 +152,7 @@ def _check_function(path: str, qual: str,
 
     def flag(node: ast.AST, msg: str) -> None:
         out.append(Finding(path, getattr(node, "lineno", fn.lineno),
-                           qual, msg))
+                           CHECKER, qual, msg))
 
     for node in ast.walk(fn):
         if isinstance(node, ast.Name):
@@ -195,22 +196,15 @@ def check_source(path: str, source: str,
     """Lint `qualnames` inside `source`; missing qualnames are findings
     too (the registry must not rot when functions are renamed)."""
     tree = ast.parse(source, filename=path)
-    found: dict[str, ast.FunctionDef] = {}
-
-    def visit(node: ast.AST, prefix: str) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                found[prefix + child.name] = child
-                visit(child, prefix + child.name + ".")
-            elif isinstance(child, ast.ClassDef):
-                visit(child, prefix + child.name + ".")
-
-    visit(tree, "")
+    found, classes = collect_functions(tree)
     out: list[Finding] = []
     for qual in qualnames:
         fn = found.get(qual)
         if fn is None:
-            out.append(Finding(path, 1, qual,
+            # anchored at the enclosing class when it exists, so the
+            # finding lands where the rename happened — not at line 1
+            line = enclosing_class_line(classes, qual)
+            out.append(Finding(path, line, CHECKER, qual,
                                "registered hot-path function not found "
                                "(renamed? update HOT_PATHS)"))
             continue
@@ -219,14 +213,25 @@ def check_source(path: str, source: str,
 
 
 def check_hot_paths(root: str | None = None) -> list[Finding]:
-    """Run the lint over every registered file. `root` defaults to the
-    repository root (two levels above this file's package)."""
+    """Run the lint over every registered file. `root` defaults to
+    the repository root."""
     if root is None:
-        root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
+        root = default_root()
     out: list[Finding] = []
     for rel, quals in HOT_PATHS.items():
-        path = os.path.join(root, rel)
-        with open(path) as f:
-            out.extend(check_source(rel, f.read(), quals))
+        source, missing = read_rostered(root, rel, CHECKER)
+        if missing is not None:
+            out.append(missing)
+            continue
+        out.extend(check_source(rel, source, quals))
     return out
+
+
+register_pass(Pass(
+    id=CHECKER,
+    title="per-iteration scheduler code must stay free of device work, "
+          "blocking syncs, numpy allocation, wall-clock reads, and "
+          "host I/O",
+    run=check_hot_paths,
+    roster=lambda root: tuple(HOT_PATHS),
+))

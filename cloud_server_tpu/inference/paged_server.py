@@ -1319,11 +1319,17 @@ class PagedInferenceServer:
                     lens[r] = len(prompts[i])
                 vecs = _engine.encode(self.params, jnp.asarray(rows),
                                       jnp.asarray(lens), cfg=self.cfg)
+                # analysis: allow[lock-discipline] deliberate sync under
+                # _step_lock: embeddings share the device with decode
+                # dispatches — serializing on the step lock is the point
                 out[idxs] = np.asarray(jax.device_get(vecs))[:len(idxs)]
         return out
 
     @property
     def num_active(self) -> int:
+        # analysis: allow[lock-discipline] racy-by-design monitoring
+        # read: len-stable list, GIL-atomic element loads; staleness is
+        # bounded by one iteration and only steers placement/idle checks
         return sum(s is not None for s in self._slots)
 
     @property
@@ -1358,6 +1364,8 @@ class PagedInferenceServer:
         """Register (compile + restack) a pattern; returns its grammar
         id. Called from submit() so compilation errors surface on the
         CLIENT thread as ValueError, never killing the scheduler."""
+        # analysis: allow[lock-discipline] double-checked fast path: a
+        # GIL-atomic dict probe; the locked re-check below is authoritative
         gid = self._pattern_gid.get(pattern)
         if gid is not None:
             return gid
@@ -1375,7 +1383,7 @@ class PagedInferenceServer:
                 self._patterns.append(pattern)
                 self._pattern_gid[pattern] = len(self._patterns)
                 self._rebuild_grammar_stack()
-        return self._pattern_gid[pattern]
+            return self._pattern_gid[pattern]
 
     def _rebuild_grammar_stack(self) -> None:
         """(Gn, S_max, V) device stack: gid 0 = the identity grammar
@@ -1558,8 +1566,13 @@ class PagedInferenceServer:
                     and bool(req.sampling.logit_bias))
                 if (req.sampling is not None
                         and req.sampling.regex is not None):
-                    self._gid[slot_id] = self._grammar_gid(
-                        req.sampling.regex)
+                    # direct registry read, NOT _grammar_gid(): that
+                    # helper takes _lock — already held here — and
+                    # submit() guarantees every admitted request's
+                    # pattern is registered (patterns are never
+                    # removed, so continuations re-hit it too)
+                    self._gid[slot_id] = self._pattern_gid[
+                        req.sampling.regex]
                     # continuations resume mid-pattern: replay the
                     # already-generated tokens host-side
                     self._gstate0[slot_id] = self._grammar_cache.get(
@@ -1699,6 +1712,10 @@ class PagedInferenceServer:
             self._next_rng(), jax.tree.map(jnp.asarray, samp_g),
             jnp.asarray(orig_lens, jnp.int32), jnp.asarray(count_mask),
             gid_g, gst0_g,
+            # analysis: allow[lock-discipline] _grammar_dev is rebuilt
+            # under _lock at submit/registration time, BEFORE any
+            # request using the new gid can reach admission; the
+            # scheduler reads one atomically-swapped reference
             self._grammar_dev if use_grammar else None,
             self.adapters.device_args() if use_lora else None, aid_g,
             self.draft_params,
@@ -1706,6 +1723,9 @@ class PagedInferenceServer:
             scatter_prompt=(c == 0), mesh=self.mesh,
             draft_cfg=self.draft_cfg, use_rows=use_rows,
             use_bias=use_bias)
+        # analysis: allow[lock-discipline] THE sanctioned per-iteration
+        # host sync — _step_lock serializes the scheduler by design
+        # (the dispatch-discipline pass pins the sanctioned set)
         toks, lps = jax.device_get((toks, lps))
         toks, lps = np.asarray(toks)[:g], np.asarray(lps)[:g]
         job.toks = np.where(in_range, toks, job.toks)
@@ -1966,6 +1986,8 @@ class PagedInferenceServer:
         use_bias = bool((self._has_bias & live).any())
         use_grammar = bool(((self._gid > 0) & live).any())
         gid = jnp.asarray(gid_np)
+        # analysis: allow[lock-discipline] atomically-swapped reference,
+        # rebuilt under _lock before any request using it is admitted
         grammar = self._grammar_dev if use_grammar else None
         use_lora = bool(((self._aid > 0) & live).any())
         lora = self.adapters.device_args() if use_lora else None
@@ -1983,6 +2005,8 @@ class PagedInferenceServer:
                 n_drafts=g_iter, mesh=self.mesh,
                 draft_cfg=self.draft_cfg, use_rows=use_rows,
                 use_bias=use_bias)
+            # analysis: allow[lock-discipline] THE sanctioned
+            # per-iteration host sync under _step_lock (speculative arm)
             toks, lps, counts, lens, last = jax.device_get(
                 (toks, lps, counts, lens, last))
         else:
@@ -1991,6 +2015,8 @@ class PagedInferenceServer:
                 gid, grammar, lora, aid, sl_dev,
                 cfg=self.cfg, infer_cfg=self.infer_cfg, n_rounds=n,
                 mesh=self.mesh, use_rows=use_rows, use_bias=use_bias)
+            # analysis: allow[lock-discipline] THE sanctioned
+            # per-iteration host sync under _step_lock (plain arm)
             toks, lps, counts, lens, last = jax.device_get(
                 (toks, lps, counts, lens, last))
             toks, lps = toks[:, :, None], lps[:, :, None]
@@ -2267,6 +2293,8 @@ class PagedInferenceServer:
                 None if spec_lens is None else jnp.asarray(
                     self._pad_limits(spec_lens, int(live_g.shape[0]))),
                 self._next_rng(),
+                # analysis: allow[lock-discipline] atomically-swapped
+                # reference, rebuilt under _lock pre-admission
                 self._grammar_dev if use_grammar else None,
                 self.adapters.device_args() if use_lora else None,
                 jnp.asarray(aid_g), jnp.asarray(aid_d),
@@ -2277,6 +2305,9 @@ class PagedInferenceServer:
                 draft_cfg=self.draft_cfg,
                 use_rows_p=use_rows_p, use_bias_p=use_bias_p,
                 use_rows_d=use_rows_d, use_bias_d=use_bias_d)
+        # analysis: allow[lock-discipline] THE sanctioned per-iteration
+        # host sync — one fused dispatch, one device_get, under the
+        # step lock that serializes the scheduler by design
         ptoks, plps, toks, lps, counts, lens, last = jax.device_get(
             (ptoks, plps, toks, lps, counts, lens, last))
 
@@ -2431,6 +2462,9 @@ class PagedInferenceServer:
                       self.num_pending)
         reg.gauge("admission_jobs",
                   "Chunked-prefill admission jobs in flight").set(
+                      # analysis: allow[lock-discipline] scrape-path
+                      # len() of a GIL-atomic list; a gauge may lag
+                      # the iteration that is mutating it
                       len(self._jobs))
         reg.counter("tokens_emitted_total",
                     "Lifetime generated tokens").set_total(
@@ -2520,6 +2554,9 @@ class PagedInferenceServer:
         while draining or stopped, so load balancers — and the
         ReplicatedRouter's placement — stop routing new work here
         while in-flight requests finish."""
+        # analysis: allow[lock-discipline] benign racy read: a stale
+        # verdict delays placement by one pick; taking _lock here would
+        # put a contended acquire on every router _pick
         return not self._draining and not self._stop.is_set()
 
     def lookup_trace(self, request_id: str) -> dict | None:
@@ -2552,23 +2589,40 @@ class PagedInferenceServer:
         self.tracer.request(n_steps, logdir)
 
     def run_until_idle(self) -> None:
+        # analysis: allow[lock-discipline] idle-polling bool() of a
+        # GIL-atomic list; step() below observes the exact state
         while self.num_pending or self.num_active or self._jobs:
             self.step()
 
     def _fail_all(self, exc: BaseException) -> None:
-        with self._lock:
-            pending, self._pending = list(self._pending), collections.deque()
-        for sid in range(self.max_slots):
-            if self._slots[sid] is not None:
-                # keyed_tokens=[] — drops the refs (keeping the
-                # allocator consistent for any future recovery path) but
-                # keys NOTHING: a failed dispatch may have left these
-                # pages half-written, so they must not enter the prefix
-                # cache as valid KV
-                slot = self._release_slot(sid, [])
-                slot.req.finish_reason = f"error: {exc!r}"
-                self._complete(slot.req)
-        self._jobs.clear()
+        # BOUNDED step-lock acquire: teardown serializes against any
+        # concurrent step() (another thread may be mid-iteration when
+        # stop() gives up on a drain), so slot state is never torn
+        # down under a live dispatch — but a scheduler thread WEDGED
+        # inside a dispatch (device hang) still holds _step_lock, and
+        # failing everyone must unblock waiters rather than hang with
+        # it, so after the timeout teardown proceeds unserialized
+        # (nothing else will ever release that lock). The crashed
+        # serve_forever path acquires instantly — its step() exited.
+        got = self._step_lock.acquire(timeout=5.0)
+        try:
+            with self._lock:
+                pending, self._pending = (list(self._pending),
+                                          collections.deque())
+            for sid in range(self.max_slots):
+                if self._slots[sid] is not None:
+                    # keyed_tokens=[] — drops the refs (keeping the
+                    # allocator consistent for any future recovery
+                    # path) but keys NOTHING: a failed dispatch may
+                    # have left these pages half-written, so they must
+                    # not enter the prefix cache as valid KV
+                    slot = self._release_slot(sid, [])
+                    slot.req.finish_reason = f"error: {exc!r}"
+                    self._complete(slot.req)
+            self._jobs.clear()
+        finally:
+            if got:
+                self._step_lock.release()
         for req in pending:
             if self.qos is not None:
                 self.qos.on_pending_removed(req.tenant)
@@ -2585,12 +2639,18 @@ class PagedInferenceServer:
                 self._fail_all(exc)
                 self._stop.set()
                 return
+            # analysis: allow[lock-discipline] idle-polling read on the
+            # scheduler's own thread — the only _jobs writer
             if busy == 0 and self.num_pending == 0 and not self._jobs:
                 self._stop.wait(idle_sleep_s)
 
     def start(self) -> "PagedInferenceServer":
         self._stop.clear()
-        self._draining = False  # a stopped-then-restarted server serves
+        with self._lock:
+            # under the state lock like every other _draining flip: a
+            # stopped-then-restarted server serves again, and a racing
+            # submit sees either verdict cleanly, never a torn latch
+            self._draining = False
         self._thread = threading.Thread(target=self.serve_forever,
                                         daemon=True,
                                         name="paged-inference-server")
@@ -2616,6 +2676,8 @@ class PagedInferenceServer:
                     else time.perf_counter() + timeout)
 
         def busy() -> bool:
+            # analysis: allow[lock-discipline] idle-polling bool() of a
+            # GIL-atomic list; drain only needs eventual quiescence
             return bool(self.num_pending or self.num_active or self._jobs)
 
         while busy():
@@ -2647,6 +2709,8 @@ class PagedInferenceServer:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        # analysis: allow[lock-discipline] post-join read: the scheduler
+        # thread is dead (or never ran) by this point
         if self.num_pending or self.num_active or self._jobs:
             # a timed-out (or skipped) drain left live requests behind:
             # nothing will ever step them now — unblock their waiters

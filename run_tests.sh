@@ -29,23 +29,26 @@
 # tests/test_spec_control.py (adaptive speculation: controller law,
 # the mixed+draft-spec+adaptive dispatch-count clone, /stats merge)
 # rides [s-z] with test_speculative.py, and tests/test_analysis.py
-# (the stdlib-only hot-path lint gate over inference/qos.py +
-# inference/spec_control.py + serving_metrics.py) rides [a-f]. The
-# lint is also runnable standalone:
-#   python -m cloud_server_tpu.analysis
+# (the stdlib-only static-analysis gate: hot-path lint +
+# lock-discipline + dispatch-discipline, see docs/analysis.md) rides
+# [a-f]. The suite is also runnable standalone:
+#   python -m cloud_server_tpu.analysis [--json] [--checker <id>]
 MARK=(-m "not slow")
 if [ "$1" = "--all" ]; then
     MARK=(); shift
 fi
 if [ "$#" -eq 0 ]; then set -- -x -q; fi
 
-# Hot-path lint as an EXPLICIT suite step (stdlib-only, ~instant), not
-# only via tests/test_analysis.py: the per-iteration scheduler code in
-# the scan roster (qos.py, serving_metrics.py, request_trace.py's
-# span-record path, slo.py) must stay free of device work, blocking
-# syncs, numpy allocation, wall-clock reads, and host I/O — and a
-# failure here reads as "hot-path regression", loudly, before any
-# pytest output scrolls past.
+# The static-analysis suite as an EXPLICIT gating step (stdlib-only,
+# ~instant), not only via tests/test_analysis.py: ALL passes run —
+# hot-path (per-iteration scheduler code free of device work/syncs/
+# allocation/wall-clock/I-O), lock-discipline (guarded-attribute and
+# _step_lock -> _lock ordering audit over the serving modules), and
+# dispatch-discipline (one sanctioned device_get per iteration,
+# jax-free host-policy modules, bounded jit static args). The exit
+# code propagates, so a failure here reads as "serving invariant
+# regression", loudly, before any pytest output scrolls past.
+# Checker catalog + suppression-pragma syntax: docs/analysis.md.
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m cloud_server_tpu.analysis || exit $?
 

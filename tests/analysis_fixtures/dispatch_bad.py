@@ -1,0 +1,73 @@
+"""Fixture: every dispatch-discipline violation class.
+
+NOT imported — parsed by tests/test_analysis.py to prove the
+``dispatch-discipline`` checker actually fires on each rule (DD1..DD4).
+The module also imports jax at top level so the HOST-POLICY purity
+rule (DD3) can round-trip on the same source.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _core(x, *, cfg, n_rounds: int, use_rows: bool = False):
+    return x
+
+
+_jitted = partial(jax.jit, static_argnames=("cfg", "n_rounds",
+                                            "use_rows"))(_core)
+
+
+@partial(jax.jit, static_argnames=("width",))
+def _jitted_deco(x, *, width: int):
+    return x
+
+
+def _pad_pow2(n):
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class BadScheduler:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.state = None
+
+    # sanctioned in the test wiring: the one allowed device_get
+    def dispatch(self):
+        out = _jitted(self.state, cfg=self.cfg, n_rounds=2)
+        return jax.device_get(out)
+
+    # DD2: a second, unsanctioned sync point on the loop
+    def rogue_sync(self):
+        return jax.device_get(self.state)
+
+    # DD2: blocking readiness sync
+    def waiter(self):
+        return self.state.block_until_ready()
+
+    # DD2: scalar sync
+    def scalarize(self):
+        return self.state.item()
+
+    # DD2 rot (when wired as sanctioned): no device_get inside
+    def hollow_commit(self):
+        return None
+
+    # DD4: static arguments fed from unbounded data
+    def bad_rounds(self, prompt):
+        n = len(prompt)
+        return _jitted(self.state, cfg=self.cfg, n_rounds=n)
+
+    def bad_width(self, prompt):
+        return _jitted_deco(jnp.asarray(prompt), width=len(prompt))
+
+    # DD4 (clean shape, for contrast): bucketed values stay bounded
+    def good_rounds(self, prompt):
+        n = min(_pad_pow2(len(prompt)), 8)
+        return _jitted(self.state, cfg=self.cfg, n_rounds=n,
+                       use_rows=bool(prompt))

@@ -1,0 +1,34 @@
+"""Fixture: suppression-pragma semantics.
+
+Two identical lock-discipline violations; ONE carries a reasoned
+``allow[lock-discipline]`` pragma (and must be suppressed), the other
+must survive. A third pragma has no reason and must itself become a
+``pragma`` finding.
+"""
+
+import threading
+import time
+
+
+class Suppressed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = 0
+
+    def poke(self):
+        with self._lock:
+            self._state += 1
+
+    def allowed_sleep(self):
+        with self._lock:
+            # analysis: allow[lock-discipline] test fixture: proves a
+            # reasoned pragma silences exactly this finding
+            time.sleep(0.001)
+
+    def unallowed_sleep(self):
+        with self._lock:
+            time.sleep(0.001)
+
+    def reasonless(self):
+        # analysis: allow[lock-discipline]
+        return self._state

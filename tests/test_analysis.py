@@ -1,13 +1,25 @@
-"""The hot-path lint gate: per-iteration scheduler code (QoS admission
-policy, metric observe ops) must stay free of device work, blocking
-syncs, numpy-buffer allocation, wall-clock reads, and host I/O — and
-the checker itself must actually catch each violation class (fixture
-round-trip). Stdlib-only: this file never imports jax."""
+"""The static-analysis gate: the multi-pass framework (registry,
+suppression pragmas, reporters) plus every checker's fixture
+round-trip — hot-path sync/allocation rules, lock discipline
+(LD1..LD4), and dispatch discipline (DD1..DD4). The whole suite must
+run clean over the real serving stack (suppressions honored), and
+each checker must actually catch each violation class. Stdlib-only:
+this file never imports jax (the fixtures mentioning jax are PARSED,
+never imported)."""
 
+import json
 import pathlib
+import re
+import subprocess
+import sys
 
-from cloud_server_tpu.analysis import (HOT_PATHS, check_hot_paths,
-                                       check_source)
+from cloud_server_tpu.analysis import (HOT_PATHS, Finding,
+                                       apply_pragmas, check_hot_paths,
+                                       check_source, collect_pragmas,
+                                       dispatch, locks,
+                                       registered_passes, report_json,
+                                       run_analysis)
+from cloud_server_tpu.analysis.framework import pragma_lines
 
 _HERE = pathlib.Path(__file__).resolve().parent
 _FIXTURES = _HERE / "analysis_fixtures"
@@ -132,3 +144,458 @@ def test_checker_flags_missing_registration():
     findings = check_source("x.py", "def f():\n    pass\n",
                             ("DoesNotExist.method",))
     assert findings and "not found" in findings[0].message
+
+
+def test_missing_registration_anchors_at_enclosing_class():
+    """A registered qualname whose method was renamed reports at the
+    ENCLOSING CLASS's line when the class still exists (line 1 only
+    when even the class is gone)."""
+    src = ("import os\n\n\n"
+           "class Keeper:\n"
+           "    def other(self):\n"
+           "        pass\n")
+    findings = check_source("x.py", src, ("Keeper.gone",))
+    assert len(findings) == 1 and findings[0].line == 4
+    findings = check_source("x.py", src, ("Vanished.gone",))
+    assert len(findings) == 1 and findings[0].line == 1
+
+
+# -- framework --------------------------------------------------------------
+
+def test_pass_registry_has_all_three_checkers():
+    assert set(registered_passes()) == {
+        "hot-path", "lock-discipline", "dispatch-discipline"}
+
+
+def test_finding_renders_path_line_checker_symbol():
+    f = Finding("a/b.py", 7, "lock-discipline", "C.m", "boom")
+    assert str(f) == "a/b.py:7: [lock-discipline] [C.m] boom"
+
+
+def test_run_analysis_over_repo_is_clean():
+    """THE gate: all three checkers over the real serving stack, zero
+    unsuppressed findings — and the deliberate exceptions really are
+    carried as reasoned pragmas (suppressed is non-empty)."""
+    report = run_analysis(str(_HERE.parent))
+    assert report.ok, "\n".join(str(f) for f in report.findings)
+    assert set(report.checkers) == set(registered_passes())
+    assert report.suppressed, (
+        "expected the serving stack's deliberate exceptions "
+        "(sanctioned syncs, monitoring reads) to ride as pragmas")
+    for f, reason in report.suppressed:
+        assert reason.strip()
+
+
+def test_run_analysis_checker_filter():
+    report = run_analysis(str(_HERE.parent), checkers=["hot-path"])
+    assert report.checkers == ("hot-path",)
+    assert report.ok
+    try:
+        run_analysis(str(_HERE.parent), checkers=["nope"])
+    except KeyError as exc:
+        assert "nope" in str(exc)
+    else:
+        raise AssertionError("unknown checker id must raise")
+
+
+# -- suppression pragmas ----------------------------------------------------
+
+def test_pragma_silences_exactly_one_finding():
+    """The suppression fixture has two identical sleep-under-lock
+    violations; the reasoned pragma kills exactly the one it
+    annotates, and the reason-less pragma is itself a finding."""
+    src = (_FIXTURES / "suppression.py").read_text()
+    raw = locks.check_source("suppression.py", src)
+    sleeps = [f for f in raw if "sleep" in f.message]
+    assert len(sleeps) == 2, [str(f) for f in raw]
+    pragmas, bad = collect_pragmas("suppression.py", src)
+    kept, suppressed = apply_pragmas(pragma_lines(pragmas), raw)
+    assert len(suppressed) == 1
+    assert "sleep" in suppressed[0][0].message
+    assert "test fixture" in suppressed[0][1]
+    assert sum("sleep" in f.message for f in kept) == 1
+    # the reason-less pragma is a `pragma` finding and suppresses
+    # nothing: the LD1 read it sits above must survive in `kept`
+    assert len(bad) == 1 and bad[0].checker == "pragma"
+    assert any(f.checker == "lock-discipline" and "_state" in f.message
+               for f in kept)
+
+
+def test_pragma_on_comment_line_covers_next_statement():
+    pragmas, bad = collect_pragmas("x.py", (
+        "# analysis: allow[hot-path] spans a\n"
+        "# second comment line\n"
+        "do_thing()\n"))
+    assert not bad
+    by_line = pragma_lines(pragmas)
+    assert "hot-path" in by_line.get(1, {})
+    assert "hot-path" in by_line.get(3, {})
+
+
+def test_stale_pragma_is_a_finding(tmp_path):
+    """A suppression whose checker ran but that matched nothing is
+    rot: it would silently swallow the next finding on its line."""
+    import cloud_server_tpu.analysis.locks as locks_mod
+    clean = ("import threading\n"
+             "class C:\n"
+             "    def __init__(self):\n"
+             "        self._lock = threading.Lock()\n"
+             "    def fine(self):\n"
+             "        # analysis: allow[lock-discipline] nothing here\n"
+             "        return 1\n")
+    target = tmp_path / "cloud_server_tpu" / "inference"
+    target.mkdir(parents=True)
+    for rel in locks_mod.LOCK_ROSTER:
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(clean if rel.endswith("qos.py")
+                     else "X = 1\n", encoding="utf-8")
+    report = run_analysis(str(tmp_path),
+                          checkers=["lock-discipline"])
+    assert any(f.checker == "pragma" and "stale" in f.message
+               for f in report.findings), \
+        [str(f) for f in report.findings]
+
+
+def test_unknown_checker_pragma_is_a_finding(tmp_path):
+    for rel in locks.LOCK_ROSTER:
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        body = "X = 1\n"
+        if rel.endswith("slo.py"):
+            body = "# analysis: allow[lockdiscipline] typo'd id\nX = 1\n"
+        p.write_text(body, encoding="utf-8")
+    report = run_analysis(str(tmp_path),
+                          checkers=["lock-discipline"])
+    assert any(f.checker == "pragma" and "unknown checker" in f.message
+               for f in report.findings), \
+        [str(f) for f in report.findings]
+
+
+# -- lock-discipline --------------------------------------------------------
+
+def test_locks_flags_each_violation_class():
+    src = (_FIXTURES / "locks_bad.py").read_text()
+    findings = locks.check_source("locks_bad.py", src)
+    by_symbol = {}
+    for f in findings:
+        by_symbol.setdefault(f.symbol, []).append(f.message)
+    cases = {
+        "BadServer.peek_unlocked": ("read of _pending", "LD1"),
+        "BadServer.reset_unlocked": ("write to _draining", "LD1"),
+        "BadServer._split": ("split guard", "LD2"),
+        "BadServer.sleepy_hold": ("sleep", "LD3"),
+        "BadServer.sync_hold": ("device_get", "LD3"),
+        "BadServer.io_hold": ("print", "LD3"),
+        "BadServer.queue_hold": ("queue get with no timeout", "LD3"),
+        "BadServer.backwards": ("_step_lock -> _lock order", "LD4"),
+        "BadServer.backwards_oneliner": ("_step_lock -> _lock order",
+                                         "LD4"),
+        "BadServer._relock": ("self-deadlock", "LD4"),
+    }
+    for symbol, (needle, rule) in cases.items():
+        msgs = by_symbol.get(symbol, [])
+        assert any(needle in m and rule in m for m in msgs), (
+            f"{symbol}: expected {needle!r} ({rule}); got {msgs} "
+            f"(all: {[str(f) for f in findings]})")
+
+
+def test_locks_accepts_disciplined_fixture():
+    src = (_FIXTURES / "locks_good.py").read_text()
+    findings = locks.check_source("locks_good.py", src)
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def test_locks_roster_covers_acceptance_files():
+    """The pass must keep auditing the serving modules the invariants
+    live in — paged_server (both mutexes + ordering), router, qos."""
+    for rel in ("cloud_server_tpu/inference/paged_server.py",
+                "cloud_server_tpu/inference/router.py",
+                "cloud_server_tpu/inference/qos.py"):
+        assert rel in locks.LOCK_ROSTER, f"{rel} dropped from roster"
+    assert locks.LOCK_ORDER == ("_step_lock", "_lock")
+
+
+def test_locks_guard_inference_uses_must_held_call_sites():
+    """A helper whose every call site holds the lock (the `_locked`
+    suffix convention) inherits it — and a new lock-free caller
+    demotes the helper's must-held set, so its writes to guarded
+    state start flagging (the `_fail_all` -> `_release_slot` story
+    that made the teardown path take the step lock)."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._x = 0\n"
+        "    def set(self):\n"
+        "        with self._lock:\n"
+        "            self._x = 0\n"
+        "    def _bump_locked(self):\n"
+        "        self._x += 1\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._bump_locked()\n")
+    assert not locks.check_source("c.py", src)
+    # add an unlocked caller: the helper's must-held set collapses to
+    # {} and its write to _lock-guarded _x becomes a violation
+    leaky = src + ("    def leak(self):\n"
+                   "        self._bump_locked()\n")
+    findings = locks.check_source("c.py", leaky)
+    assert any("write to _x" in f.message for f in findings), \
+        [str(f) for f in findings]
+
+
+# -- dispatch-discipline ----------------------------------------------------
+
+_DISPATCH_LOOP = tuple(
+    f"BadScheduler.{m}" for m in
+    ("dispatch", "rogue_sync", "waiter", "scalarize", "hollow_commit",
+     "bad_rounds", "bad_width", "good_rounds"))
+_DISPATCH_SANCTIONED = ("BadScheduler.dispatch",
+                        "BadScheduler.hollow_commit")
+
+
+def test_dispatch_flags_each_violation_class():
+    src = (_FIXTURES / "dispatch_bad.py").read_text()
+    findings = dispatch.check_scheduler_source(
+        "dispatch_bad.py", src, _DISPATCH_LOOP, _DISPATCH_SANCTIONED)
+    by_symbol = {}
+    for f in findings:
+        by_symbol.setdefault(f.symbol, []).append(f.message)
+    cases = {
+        "BadScheduler.rogue_sync": "outside the sanctioned",
+        "BadScheduler.waiter": "block_until_ready",
+        "BadScheduler.scalarize": "item",
+        "BadScheduler.hollow_commit": "sanction list has rotted",
+        "BadScheduler.bad_rounds": "static argument 'n_rounds'",
+        "BadScheduler.bad_width": "static argument 'width'",
+    }
+    for symbol, needle in cases.items():
+        msgs = by_symbol.get(symbol, [])
+        assert any(needle in m for m in msgs), (
+            f"{symbol}: expected {needle!r}; got {msgs}")
+    # the sanctioned sync and the bounded/bool static feeds are clean
+    assert "BadScheduler.dispatch" not in by_symbol
+    assert "BadScheduler.good_rounds" not in by_symbol
+
+
+def test_dispatch_missing_roster_function_is_a_finding():
+    src = (_FIXTURES / "dispatch_bad.py").read_text()
+    findings = dispatch.check_scheduler_source(
+        "dispatch_bad.py", src, ("BadScheduler.vanished",), ())
+    assert findings and "not found" in findings[0].message
+    assert findings[0].line > 1  # anchored at the class, not line 1
+
+
+def test_dispatch_accepts_disciplined_fixture():
+    src = (_FIXTURES / "dispatch_good.py").read_text()
+    findings = dispatch.check_scheduler_source(
+        "dispatch_good.py", src,
+        ("GoodScheduler.step", "GoodScheduler._chunk_rounds"),
+        ("GoodScheduler.step",))
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def test_dispatch_host_policy_purity():
+    src = (_FIXTURES / "dispatch_bad.py").read_text()
+    findings = dispatch.check_host_policy_source("dispatch_bad.py", src)
+    assert any("imports" in f.message for f in findings)
+    clean = ("import threading\nimport time\n\n"
+             "def policy(x):\n    return x + 1\n")
+    assert not dispatch.check_host_policy_source("policy.py", clean)
+
+
+def test_dispatch_rosters_cover_both_servers():
+    for rel in ("cloud_server_tpu/inference/paged_server.py",
+                "cloud_server_tpu/inference/server.py"):
+        assert rel in dispatch.SCHEDULER_LOOPS
+        assert dispatch.SANCTIONED_SYNCS[rel]
+    for rel in ("cloud_server_tpu/inference/qos.py",
+                "cloud_server_tpu/inference/slo.py",
+                "cloud_server_tpu/inference/request_trace.py",
+                "cloud_server_tpu/inference/spec_control.py",
+                "cloud_server_tpu/utils/serving_metrics.py"):
+        assert rel in dispatch.HOST_POLICY_MODULES
+
+
+# -- reporters / CLI --------------------------------------------------------
+
+def test_json_report_shape_is_stable():
+    """External tooling consumes --json: the top-level keys, the
+    finding fields, and the version tag are load-bearing."""
+    report = run_analysis(str(_HERE.parent))
+    doc = report_json(report)
+    assert set(doc) == {"version", "root", "checkers", "counts",
+                        "findings", "suppressed"}
+    assert doc["version"] == 1
+    assert set(doc["counts"]) == {"findings", "suppressed"}
+    assert doc["counts"]["findings"] == 0
+    assert doc["counts"]["suppressed"] == len(doc["suppressed"])
+    for entry in doc["suppressed"]:
+        assert set(entry) == {"path", "line", "checker", "symbol",
+                              "message", "reason"}
+    assert json.loads(json.dumps(doc)) == doc  # round-trips as JSON
+
+
+def test_cli_runs_clean_and_emits_json():
+    out = subprocess.run(
+        [sys.executable, "-m", "cloud_server_tpu.analysis", "--json",
+         str(_HERE.parent)],
+        capture_output=True, text=True, cwd=str(_HERE.parent))
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["counts"]["findings"] == 0
+    assert sorted(doc["checkers"]) == sorted(registered_passes())
+
+
+def test_cli_unknown_checker_is_usage_error():
+    out = subprocess.run(
+        [sys.executable, "-m", "cloud_server_tpu.analysis",
+         "--checker", "bogus", str(_HERE.parent)],
+        capture_output=True, text=True, cwd=str(_HERE.parent))
+    assert out.returncode == 2
+    assert "bogus" in out.stderr
+
+
+# -- docs drift -------------------------------------------------------------
+
+def test_checker_catalog_matches_docs():
+    """Every registered checker id appears in docs/analysis.md's
+    catalog, and vice versa — the catalog cannot rot in either
+    direction (the observability metric-catalog rule, applied to
+    checkers). The implicit `pragma` id is documented too."""
+    doc = (_HERE.parent / "docs" / "analysis.md").read_text()
+    catalog = set(re.findall(r"^\|\s*`([a-z0-9-]+)`", doc, re.M))
+    runtime = set(registered_passes()) | {"pragma"}
+    missing = runtime - catalog
+    stale = catalog - runtime
+    assert not missing, (
+        f"registered but absent from docs/analysis.md: {sorted(missing)}")
+    assert not stale, (
+        f"documented but never registered: {sorted(stale)}")
+    assert "analysis: allow[" in doc  # the pragma syntax is documented
+
+
+def test_locks_bounded_acquire_idiom_counts_as_held():
+    """`got = self._lock.acquire(timeout=...)` marks the rest of the
+    block as holding the lock — the teardown idiom `_fail_all` uses —
+    so guarded writes there stay clean, and the acquisition still
+    participates in ordering/self-deadlock checks."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._x = 0\n"
+        "    def set(self):\n"
+        "        with self._lock:\n"
+        "            self._x = 1\n"
+        "    def teardown(self):\n"
+        "        got = self._lock.acquire(timeout=5.0)\n"
+        "        try:\n"
+        "            self._x = 0\n"
+        "        finally:\n"
+        "            if got:\n"
+        "                self._lock.release()\n")
+    assert not locks.check_source("c.py", src), \
+        [str(f) for f in locks.check_source("c.py", src)]
+    # and a bounded acquire of a lock that MAY already be held still
+    # flags as a self-deadlock hazard
+    nested = src + ("    def outer(self):\n"
+                    "        with self._lock:\n"
+                    "            self.teardown()\n")
+    findings = locks.check_source("c.py", nested)
+    assert any("self-deadlock" in f.message for f in findings), \
+        [str(f) for f in findings]
+
+
+def test_dispatch_checks_positional_and_splatted_statics():
+    """Static args passed positionally map onto the callee's param
+    names; a **-splat is opaque and flags by itself."""
+    src = (
+        "from functools import partial\n"
+        "import jax\n"
+        "def _core(x, n_rounds, *, cfg=None):\n"
+        "    return x\n"
+        "_jit = partial(jax.jit, static_argnames=('n_rounds', 'cfg'))"
+        "(_core)\n"
+        "class S:\n"
+        "    def loop_pos(self, prompt):\n"
+        "        return _jit(prompt, len(prompt), cfg=None)\n"
+        "    def loop_splat(self, prompt, kw):\n"
+        "        return _jit(prompt, 2, **kw)\n"
+        "    def loop_ok(self, prompt):\n"
+        "        return _jit(prompt, 4, cfg=self.cfg)\n")
+    findings = dispatch.check_scheduler_source(
+        "s.py", src, ("S.loop_pos", "S.loop_splat", "S.loop_ok"), ())
+    msgs = [f.message for f in findings]
+    assert any("'n_rounds'" in m and f.symbol == "S.loop_pos"
+               for f, m in zip(findings, msgs)), msgs
+    assert any("**-splat" in m for m in msgs), msgs
+    assert not [f for f in findings if f.symbol == "S.loop_ok"], msgs
+
+
+def test_boundedness_tracks_walrus_assignments():
+    """`(n := len(prompt))` binds like an assignment: an unbounded
+    walrus-bound name must not slip past DD4."""
+    src = (
+        "from functools import partial\n"
+        "import jax\n"
+        "def _core(x, *, n_rounds: int):\n"
+        "    return x\n"
+        "_jit = partial(jax.jit, static_argnames=('n_rounds',))(_core)\n"
+        "class S:\n"
+        "    def loop(self, prompt):\n"
+        "        if (n := len(prompt)) > 0:\n"
+        "            return _jit(prompt, n_rounds=n)\n"
+        "        return None\n")
+    findings = dispatch.check_scheduler_source("s.py", src,
+                                               ("S.loop",), ())
+    assert any("'n_rounds'" in f.message for f in findings), \
+        [str(f) for f in findings]
+
+
+def test_missing_rostered_file_is_a_finding_not_a_traceback(tmp_path):
+    """A deleted/renamed rostered file (or a wrong root) must surface
+    as findings through the normal report, never as an unhandled
+    FileNotFoundError out of the gating step."""
+    report = run_analysis(str(tmp_path))
+    assert not report.ok
+    assert all("cannot be read" in f.message for f in report.findings)
+    assert {f.checker for f in report.findings} == set(
+        registered_passes())
+
+
+def test_locks_oneliner_double_acquire_is_self_deadlock():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def twice(self):\n"
+        "        with self._lock, self._lock:\n"
+        "            return 1\n")
+    findings = locks.check_source("c.py", src)
+    assert any("self-deadlock" in f.message for f in findings), \
+        [str(f) for f in findings]
+
+
+def test_dispatch_nonliteral_static_argnames_is_a_finding():
+    """`static_argnames=SOME_CONSTANT` defeats the boundedness
+    analysis — that must surface as 'cannot be verified', never as a
+    silent skip of every DD4 check for that callable."""
+    src = (
+        "from functools import partial\n"
+        "import jax\n"
+        "STATICS = ('n_rounds',)\n"
+        "def _core(x, *, n_rounds: int):\n"
+        "    return x\n"
+        "_jit = partial(jax.jit, static_argnames=STATICS)(_core)\n"
+        "class S:\n"
+        "    def loop(self, prompt):\n"
+        "        return _jit(prompt, n_rounds=len(prompt))\n")
+    findings = dispatch.check_scheduler_source("s.py", src,
+                                               ("S.loop",), ())
+    assert any("not a literal" in f.message for f in findings), \
+        [str(f) for f in findings]
