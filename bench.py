@@ -691,6 +691,24 @@ def _churn_scenario(params, base, infer_cfg, scheduler):
         f"external {ext_mean * 1e3:.1f} ms")
     util = [rec["budget_utilization"] for rec in flight
             if "budget_utilization" in rec]
+    # Iteration-phase profile of the same run: the host-gap fraction
+    # is the exact headroom the async double-buffered scheduler
+    # (ROADMAP item 4) can reclaim — measured per phase, not inferred
+    # from end-to-end tok/s. The per-record identity host_ms +
+    # device_wait_ms == duration_ms is asserted (the phase clock
+    # partitions the iteration by construction).
+    ph_recs = [rec for rec in flight if "phases_ms" in rec]
+    assert ph_recs, "profiling-enabled run produced no phase records"
+    for rec in ph_recs:
+        assert abs(rec["host_ms"] + rec["device_wait_ms"]
+                   - rec["duration_ms"]) <= 1e-6 * rec["duration_ms"] \
+            + 1e-6, f"phase split does not partition the iteration: {rec}"
+    host_gap = (sum(r["host_ms"] for r in ph_recs)
+                / max(sum(r["duration_ms"] for r in ph_recs), 1e-9))
+    phase_keys = {}
+    for ph in ("admission", "build", "device", "epilogue"):
+        vals = [r["phases_ms"].get(ph, 0.0) for r in ph_recs]
+        phase_keys[f"churn_phase_ms_{ph}_p50"] = pct(vals, 0.50)
     # SLO view of the same run (lifetime counts — deterministic, no
     # window-edge sensitivity): default-class attainment per metric
     slo_keys = {}
@@ -718,7 +736,12 @@ def _churn_scenario(params, base, infer_cfg, scheduler):
             "churn_srv_itl_ms_p99":
                 histogram_percentile(h_itl, 0.99) * 1e3,
             "churn_budget_utilization_mean":
-                sum(util) / len(util) if util else 0.0}
+                sum(util) / len(util) if util else 0.0,
+            # host-gap attribution (iteration_profile.py): the share
+            # of each iteration the device idles while the host works
+            # — ROADMAP item 4's claimable headroom, per phase
+            "churn_host_gap_frac": host_gap,
+            **phase_keys}
 
 
 def _qos_isolation_bench(params, base, infer_cfg):

@@ -61,6 +61,19 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "TraceRecorder.begin",
         "TraceRecorder.finish",
     ),
+    # iteration-phase profiler: begin/mark run at every phase
+    # boundary of every scheduler iteration (the tightest loop this
+    # roster covers — a stray allocation or sync here would taint the
+    # very attribution it produces); phases_ms feeds the per-busy-
+    # iteration flight record. The summary/export functions
+    # (profile_summary, scheduler_chrome_trace) are read-path only
+    # and deliberately absent.
+    "cloud_server_tpu/inference/iteration_profile.py": (
+        "IterationProfiler.begin",
+        "IterationProfiler.mark",
+        "IterationProfiler.phases_ms",
+        "derive_gap_fields",
+    ),
     # SLO tracking: observe() runs at admit / first-token / emit /
     # finish host moments; report/mirror are scrape-path only
     "cloud_server_tpu/inference/slo.py": (

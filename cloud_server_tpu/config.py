@@ -233,6 +233,17 @@ class InferConfig:
     # parses it at construction. Constructor argument `spec_control=`
     # (a config, a ready SpecController, or False) overrides.
     spec_control_config: str = ""
+    # Iteration-phase profiler (inference/iteration_profile.py): stamp
+    # every scheduler iteration's phase boundaries (sweep / admission /
+    # build / device / commit / epilogue) with a bounded number of
+    # perf_counter reads — zero added dispatches or syncs. Feeds the
+    # flight recorder (`phases_ms`, `host_ms`, `device_wait_ms`,
+    # `host_gap_frac`), the `cloud_server_iter_phase_ms` histograms,
+    # the /stats `iteration_profile` summary, and the
+    # GET /debug/scheduler_trace Perfetto export. False restores the
+    # exact pre-profiler clock behavior (two reads per busy
+    # iteration). Constructor argument `iteration_profile=` overrides.
+    iteration_profile: bool = True
     # Per-class SLO targets (inference/slo.py): a JSON object as a
     # string, or a path to a JSON file, declaring per-priority-class
     # latency targets (ttft/itl/queue_wait/e2e) and attainment

@@ -182,6 +182,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "carry span trees (GET /debug/requests/<id>, "
                    "Perfetto export via GET /traces, W3C traceparent "
                    "in/out). 0 (default) disables tracing entirely")
+    p.add_argument("--no-iteration-profile", action="store_true",
+                   help="disable the iteration-phase profiler (on by "
+                   "default: per-iteration sweep/admission/build/"
+                   "device/commit/epilogue attribution in flight "
+                   "records, cloud_server_iter_phase_ms histograms, "
+                   "/stats iteration_profile, and the "
+                   "GET /debug/scheduler_trace Perfetto export)")
     p.add_argument("--ngram-draft", action="store_true",
                    help="speculative decoding WITHOUT a draft model: "
                    "propose continuations of repeated n-grams from the "
@@ -368,7 +375,8 @@ def main(argv=None) -> None:
                 prefix_tokens=prefix_toks,
                 qos=args.qos_config,
                 slo=args.slo_config,
-                tracing=args.trace_sample_rate or None)
+                tracing=args.trace_sample_rate or None,
+                iteration_profile=False if args.no_iteration_profile else None)
         if args.prefix:
             print("[generate] note: the paged server reuses shared "
                   "prefixes automatically (radix page cache); --prefix "
@@ -400,6 +408,7 @@ def main(argv=None) -> None:
             qos=args.qos_config,
             slo=args.slo_config,
             tracing=args.trace_sample_rate or None,
+            iteration_profile=False if args.no_iteration_profile else None,
             tokenizer=tok)  # regex-constrained requests compile vs it
 
     if args.serve_http is not None:

@@ -70,7 +70,21 @@ Endpoints:
   GET  /stats       JSON aggregates (histogram summaries with
                     interpolated percentiles, counters, gauges) plus
                     the scheduler flight recorder's recent window
-                    (?n=K bounds the window, default 64).
+                    (?n=K bounds the window, default 64) and — with
+                    the iteration profiler on (the default) — an
+                    `iteration_profile` summary (per-phase
+                    count/mean/p50/p99 ms + host_gap_frac).
+  GET  /debug/scheduler_trace  Chrome-trace/Perfetto export of the
+                    flight recorder's recent window (?n=K, default
+                    64): one track per scheduler phase (sweep /
+                    admission / build / device / commit / epilogue)
+                    plus an iteration track carrying each record's
+                    scalars. Same perf_counter timebase as /traces,
+                    and every event tags its flight-recorder
+                    iteration index — the two-way cross-link between
+                    "this request's decode_segment was slow" and
+                    "what the scheduler was doing that iteration"
+                    (inference/iteration_profile.py).
   POST /debug/trace {"steps": N, "logdir": optional} — wrap the next N
                     scheduler iterations in a jax profiler trace
                     (utils.tracing.capture_trace); returns the logdir
@@ -145,6 +159,8 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from cloud_server_tpu.inference.iteration_profile import (
+    profile_summary, scheduler_chrome_trace)
 from cloud_server_tpu.inference.request_trace import (
     TRACEPARENT_HEADER, chrome_trace, format_traceparent,
     parse_traceparent)
@@ -414,6 +430,19 @@ class HttpFrontend:
                             "the request sampled)"})
                     else:
                         self._json(200, tree)
+                elif url.path == "/debug/scheduler_trace":
+                    fn = getattr(front.srv, "flight_window", None)
+                    if fn is None:
+                        self._json(404, {"error": "this serving backend "
+                                         "has no flight recorder"})
+                        return
+                    try:
+                        n = _query_int(url, "n", 64)
+                    except ValueError:
+                        self._json(400, {"error": '"n" must be an int'})
+                        return
+                    self._json(200, scheduler_chrome_trace(
+                        fn(n) if n > 0 else []))
                 elif url.path == "/metrics":
                     body = front._metrics_text().encode()
                     self.send_response(200)
@@ -550,6 +579,13 @@ class HttpFrontend:
             # n bounds the window; n <= 0 means "no records", never
             # "everything" (256+ per-iteration dicts)
             payload["flight_recorder"] = fn(n) if n > 0 else []
+        # iteration-phase profile: per-phase p50/p99 + host_gap_frac,
+        # computed from the snapshot already in hand — behind the
+        # router that snapshot is the fleet merge, so the percentiles
+        # are fleet-wide for free. Absent when profiling is disabled.
+        profile = profile_summary(snap)
+        if profile is not None:
+            payload["iteration_profile"] = profile
         # speculative decoding: drafted/accepted totals, the accept
         # rate, and (adaptive) the live per-slot draft lengths.
         # ReplicatedRouter's speculation_stats() merges counts across

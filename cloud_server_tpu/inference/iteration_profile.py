@@ -1,0 +1,275 @@
+"""Iteration-phase profiler: host-gap attribution for the scheduler
+hot loop.
+
+The flight recorder (PR 3) stamps every busy scheduler iteration with
+one `duration_ms` — enough to see that an iteration was slow, not
+enough to say WHERE the time went. Before the async double-buffered
+scheduler (ROADMAP item 4) can claim to overlap host policy work with
+device compute, the measurement layer must exist: per-phase
+attribution of every iteration, so the host-gap the overlap will hide
+is a measured number (`host_gap_frac`), not an inference from
+end-to-end tok/s.
+
+Phase taxonomy (one contiguous partition of the iteration, stamped at
+boundaries the scheduler already crosses):
+
+    sweep       cancelled-request reaping at the top of step()
+    admission   QoS/DRR admission, token-budget planning, chain
+                extension/preemption policy — the host DECIDING what
+                to dispatch
+    build       host array prep (numpy staging, padding, gathers) up
+                to the jitted call
+    device      the dispatch statement (arg device transfer + launch)
+                through the one sanctioned `device_get` commit point —
+                the only phase that waits on the accelerator
+    commit      token emit / grammar / speculation bookkeeping on the
+                synced results
+    epilogue    flight-recorder / tracing / SLO bookkeeping at the end
+                of the iteration
+
+`host_gap_frac` = (everything except `device`) / duration: the
+fraction of each iteration the device sits idle while the host works.
+That is exactly the headroom item 4's overlap can reclaim — and the
+number that proves (or refutes) it per-phase once it lands.
+
+Design rules (the metrics layer's own):
+
+  * **Stdlib only, zero device work.** The clock is
+    `time.perf_counter`; a phase mark is one clock read and one dict
+    add. The module is on the analysis hot-path lint roster AND the
+    dispatch-discipline host-policy (jax-free) roster; the mixed
+    scheduler's dispatch/sync-count regression test runs a
+    profiling-enabled clone, and a bounded CONSTANT number of clock
+    reads per mixed iteration is asserted by monkeypatching
+    `perf_counter` (tests/test_iteration_profile.py).
+  * **Same plumbing as every other signal.** Phases land in the
+    flight record (`phases_ms` + derived `host_ms` /
+    `device_wait_ms` / `host_gap_frac`), in rolling per-phase
+    histograms (`cloud_server_iter_phase_ms`, labeled by phase,
+    fleet-merged bucket-for-bucket through
+    `ReplicatedRouter.metrics_snapshot()`), in `/stats`
+    (`iteration_profile`: per-phase p50/p99 + `host_gap_frac`), and
+    in a scheduler-timeline Perfetto export
+    (`GET /debug/scheduler_trace?n=K`) cross-linked to the
+    per-request span trees by the flight-recorder iteration index.
+  * **Disable-able.** `InferConfig.iteration_profile` (default on) /
+    the servers' `iteration_profile=` constructor argument; disabled
+    servers keep the exact pre-profiler clock behavior (two
+    perf_counter reads per busy iteration).
+
+Timebase note: with profiling enabled, a busy iteration's
+`duration_ms` spans the WHOLE iteration (sweep through epilogue), so
+`host_ms + device_wait_ms == duration_ms` by construction; with it
+disabled, `duration_ms` keeps its historical meaning (dispatch start
+to epilogue). Flight records gain `t_start` (the iteration's
+perf_counter start), which is what lets the scheduler timeline export
+share a timebase with the request-trace export (`GET /traces`).
+"""
+
+from __future__ import annotations
+
+from time import perf_counter
+
+from cloud_server_tpu.utils.serving_metrics import histogram_percentile
+
+# Canonical phase order — the contiguous partition of one iteration.
+PHASES = ("sweep", "admission", "build", "device", "commit", "epilogue")
+
+# Millisecond bucket ladder for the per-phase histograms: sub-0.1 ms
+# host blips through multi-second cold dispatches. Fixed at
+# registration so replica snapshots merge bucket-for-bucket.
+PHASE_MS_BUCKETS: tuple[float, ...] = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 5000.0)
+
+# Histogram family (one labeled series per phase). Shared between the
+# servers' eager registration and `profile_summary`'s snapshot walk.
+PHASE_FAMILY = "iter_phase_ms"
+_FULL_FAMILY = f"cloud_server_{PHASE_FAMILY}"
+
+# Flight-record scalars worth carrying into the Perfetto iteration
+# track's args (post-mortem context next to the phase bars).
+_ITER_ARG_KEYS = ("iteration", "scheduler", "n_live", "decode_rounds",
+                  "decode_tokens", "prefill_tokens", "tokens_scheduled",
+                  "budget_utilization", "host_ms", "device_wait_ms",
+                  "host_gap_frac", "preemptions", "pending", "n_jobs")
+
+
+class IterationProfiler:
+    """Host-side phase clock for one scheduler iteration.
+
+    `begin()` opens the iteration; `mark(phase)` attributes the time
+    since the previous mark to `phase` (marks ACCUMULATE, so a phase
+    visited several times in one iteration — e.g. `build`/`device`
+    per chunk on the alternating scheduler — sums). Both return the
+    timestamp they read so callers reuse it instead of reading the
+    clock again: the mixed scheduler pays a bounded constant number
+    of `perf_counter` reads per iteration (asserted by test)."""
+
+    __slots__ = ("t0", "_last", "_acc")
+
+    def __init__(self):
+        self.t0 = 0.0
+        self._last = 0.0
+        self._acc: dict[str, float] = {}
+
+    def begin(self) -> float:
+        t = perf_counter()
+        self.t0 = self._last = t
+        self._acc = {}
+        return t
+
+    def mark(self, phase: str) -> float:
+        t = perf_counter()
+        acc = self._acc
+        acc[phase] = acc.get(phase, 0.0) + (t - self._last)
+        self._last = t
+        return t
+
+    def phases_ms(self) -> dict[str, float]:
+        """Accumulated per-phase milliseconds, canonical order. The
+        values partition [t0, last mark]: their sum is the elapsed
+        time between those clock reads (no time is double-counted or
+        dropped), which is what makes the flight record's
+        `host_ms + device_wait_ms == duration_ms` hold exactly."""
+        acc = self._acc
+        return {p: acc[p] * 1e3 for p in PHASES if p in acc}
+
+
+def register_phase_hists(registry) -> dict:
+    """Eagerly register the per-phase histogram family on a server's
+    registry (one labeled series per phase) and return the
+    phase -> Histogram dict the per-iteration observe path indexes.
+    THE one registration site for both servers: the family name, help
+    text, and ms ladder must match everywhere or the router's
+    bucket-for-bucket fleet merge breaks."""
+    return {
+        p: registry.histogram(
+            PHASE_FAMILY,
+            "Scheduler iteration time by phase (milliseconds)",
+            buckets=PHASE_MS_BUCKETS, labels={"phase": p})
+        for p in PHASES}
+
+
+def resolve_profiler(profile,
+                     cfg_enabled: bool = True) -> IterationProfiler | None:
+    """The one constructor both servers use: `profile` may be a ready
+    IterationProfiler, True/False, "off", or None (falling back to
+    `InferConfig.iteration_profile`). Returns None when disabled —
+    every guarded call site short-circuits and the scheduler keeps
+    the exact pre-profiler clock behavior."""
+    if profile is False or profile == "off":
+        return None
+    if isinstance(profile, IterationProfiler):
+        return profile
+    if profile is True:
+        return IterationProfiler()
+    if profile is None:
+        return IterationProfiler() if cfg_enabled else None
+    raise ValueError(
+        "iteration_profile must be True, False, 'off', None, or an "
+        f"IterationProfiler; got {profile!r}")
+
+
+def derive_gap_fields(phases_ms: dict[str, float],
+                      duration_ms: float) -> dict[str, float]:
+    """The derived flight-record fields from one iteration's phase
+    split: host milliseconds (everything except the device wait), the
+    device wait itself, and the host-gap fraction of the iteration."""
+    device = phases_ms.get("device", 0.0)
+    host = sum(v for k, v in phases_ms.items() if k != "device")
+    return {"host_ms": host, "device_wait_ms": device,
+            "host_gap_frac": host / duration_ms if duration_ms > 0
+            else 0.0}
+
+
+def profile_summary(snapshot: dict) -> dict | None:
+    """The `/stats` `iteration_profile` payload from a metrics
+    snapshot (one server's, or the router's fleet-merge — the phase
+    histograms merged bucket-for-bucket upstream, so these are true
+    fleet percentiles): per-phase count/mean/p50/p99 milliseconds
+    plus the aggregate `host_gap_frac` recomputed from the merged
+    sums (a ratio must never be added across replicas). None when no
+    phase histograms are present (profiling disabled, or a backend
+    without it)."""
+    phases: dict[str, dict] = {}
+    host_ms = device_ms = 0.0
+    for key, entry in snapshot.items():
+        if not key.startswith(_FULL_FAMILY + "{") \
+                or entry.get("type") != "histogram":
+            continue
+        phase = (entry.get("labels") or {}).get("phase")
+        if phase is None:
+            continue
+        count = entry["count"]
+        phases[phase] = {
+            "count": count,
+            "mean_ms": entry["sum"] / count if count else 0.0,
+            "p50_ms": histogram_percentile(entry, 0.50),
+            "p99_ms": histogram_percentile(entry, 0.99)}
+        if phase == "device":
+            device_ms += entry["sum"]
+        else:
+            host_ms += entry["sum"]
+    if not phases:
+        return None
+    total = host_ms + device_ms
+    return {"phases": {p: phases[p] for p in PHASES if p in phases},
+            "host_ms_total": host_ms,
+            "device_wait_ms_total": device_ms,
+            "host_gap_frac": host_ms / total if total > 0 else 0.0}
+
+
+def scheduler_chrome_trace(records: list[dict]) -> dict:
+    """Render flight-recorder records as Chrome trace event format
+    JSON (chrome://tracing / ui.perfetto.dev): one process per
+    replica, one track per phase plus an `iteration` track whose args
+    carry the record's scalars. Timestamps are microseconds on the
+    servers' perf_counter timebase — the SAME timebase as the
+    request-trace export (`GET /traces`), and every event's args
+    carry the flight-recorder `iteration` index, which is also the
+    tag on every `prefill_chunk`/`decode_segment` span in a request's
+    tree: the two exports cross-link in both directions ("why was
+    this request's decode_segment slow" ↔ "what was the scheduler
+    doing that iteration").
+
+    Phases render laid out consecutively in canonical order inside
+    the iteration window; on the alternating scheduler a phase's bar
+    is its per-iteration SUM (chunks interleave build/device several
+    times), so bar order within an iteration is attribution, not a
+    literal interleaving. Records written with profiling disabled
+    carry no `t_start`/`phases_ms` and are skipped."""
+    events: list[dict] = []
+    seen_pids: set[int] = set()
+    for rec in records:
+        t0 = rec.get("t_start")
+        if t0 is None:
+            continue
+        pid = int(rec.get("replica", 0))
+        if pid not in seen_pids:
+            seen_pids.add(pid)
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pid,
+                           "args": {"name": f"scheduler replica {pid}"}})
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": 0, "args": {"name": "iteration"}})
+            for i, p in enumerate(PHASES):
+                events.append({"ph": "M", "name": "thread_name",
+                               "pid": pid, "tid": i + 1,
+                               "args": {"name": p}})
+        args = {k: rec[k] for k in _ITER_ARG_KEYS if k in rec}
+        events.append({"ph": "X",
+                       "name": f"iteration {rec.get('iteration')}",
+                       "ts": t0 * 1e6,
+                       "dur": rec.get("duration_ms", 0.0) * 1e3,
+                       "pid": pid, "tid": 0, "args": args})
+        off = t0 * 1e6
+        for i, p in enumerate(PHASES):
+            v = (rec.get("phases_ms") or {}).get(p, 0.0)
+            if v <= 0:
+                continue
+            events.append({"ph": "X", "name": p, "ts": off,
+                           "dur": v * 1e3, "pid": pid, "tid": i + 1,
+                           "args": {"iteration": rec.get("iteration")}})
+            off += v * 1e3
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -109,6 +109,7 @@ from cloud_server_tpu.config import InferConfig, ModelConfig
 from cloud_server_tpu.inference import paged_engine, sampling
 from cloud_server_tpu.inference.block_allocator import BlockAllocator
 from cloud_server_tpu.inference.grammar import DEAD as _GDEAD
+from cloud_server_tpu.inference.iteration_profile import derive_gap_fields
 from cloud_server_tpu.inference.sampling import (
     SamplingParams, SamplingRows, make_rows, sample_from_probs,
     sample_logits, sample_logits_rows, sampling_probs,
@@ -869,7 +870,8 @@ class PagedInferenceServer:
                  mixed_token_budget: int | None = None,
                  metrics: ServingMetrics | None = None,
                  flight_recorder_size: int | None = None,
-                 qos=None, tracing=None, slo=None, spec_control=None):
+                 qos=None, tracing=None, slo=None, spec_control=None,
+                 iteration_profile=None):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -1080,6 +1082,31 @@ class PagedInferenceServer:
                    else infer_cfg.flight_recorder_size)
         self.flight = FlightRecorder(fr_size)
         self._iter_stats: dict = {}
+        # iteration-phase profiler (inference/iteration_profile.py):
+        # per-phase host-gap attribution of every busy iteration —
+        # pure host-side clock marks at boundaries the scheduler
+        # already crosses, zero extra dispatches/syncs (the
+        # dispatch-count regression test runs a profiling-enabled
+        # clone, and the clock-read count per mixed iteration is
+        # asserted constant). None (iteration_profile=False / config
+        # off) keeps the exact pre-profiler two-read clock behavior.
+        from cloud_server_tpu.inference.iteration_profile import (
+            register_phase_hists, resolve_profiler)
+        self._profiler = resolve_profiler(iteration_profile,
+                                          infer_cfg.iteration_profile)
+        # eager per-phase histogram registration: the families exist
+        # (and the docs drift check sees them) before any traffic, and
+        # the per-iteration observe path is a dict lookup, not a
+        # registry get-or-create
+        self._phase_hists = ({} if self._profiler is None else
+                             register_phase_hists(self.metrics.registry))
+        # idle-iteration visibility: a dead scheduler and an idle one
+        # must not look identical from /stats — an idle one keeps
+        # incrementing idle_iterations while last_busy_ts ages, a dead
+        # one freezes both. Plain int/float writes on the scheduler
+        # thread; mirrored on the scrape path only.
+        self.idle_iterations = 0
+        self.last_busy_ts = 0.0
         # per-request distributed tracing + per-class SLO tracking
         # (inference/request_trace.py, inference/slo.py): both None
         # unless configured — every guarded call site short-circuits,
@@ -1704,6 +1731,11 @@ class PagedInferenceServer:
         use_lora = bool((self._aid[sl] > 0).any())
         aid_g = jnp.asarray(pad_rows(self._aid[sl], 0))
 
+        prof = self._profiler
+        if prof is not None:
+            # per-chunk marks ACCUMULATE into the iteration's phases
+            # (the alternating scheduler runs several chunks per step)
+            prof.mark("build")
         self.state, toks, lps = _prefill_chunk(
             self.params, self.state, jnp.asarray(chunk),
             jnp.asarray(g_lens, jnp.int32), jnp.asarray(g_tables),
@@ -1727,6 +1759,8 @@ class PagedInferenceServer:
         # host sync — _step_lock serializes the scheduler by design
         # (the dispatch-discipline pass pins the sanctioned set)
         toks, lps = jax.device_get((toks, lps))
+        if prof is not None:
+            prof.mark("device")
         toks, lps = np.asarray(toks)[:g], np.asarray(lps)[:g]
         job.toks = np.where(in_range, toks, job.toks)
         job.lps = np.where(in_range, lps, job.lps)
@@ -1753,6 +1787,8 @@ class PagedInferenceServer:
                               float(job.lps[i])):
                     self._finish(sid)
             self._jobs.remove(job)
+        if prof is not None:
+            prof.mark("commit")
 
     # -- decode -------------------------------------------------------------
 
@@ -1957,6 +1993,7 @@ class PagedInferenceServer:
             st["spec_draft_lens"] = self.spec_control.draft_lengths()
 
     def _decode_dispatch(self) -> None:
+        prof = self._profiler
         n = self._chunk_rounds()
         if self.allocation == "ondemand":
             n_eff = self._extend_chains(n)
@@ -1966,6 +2003,9 @@ class PagedInferenceServer:
             while n > n_eff:  # keep round counts powers of two (compile
                 n //= 2      # cache) while honouring chain coverage
             n = max(1, n)
+        if prof is not None:
+            # round planning + chain extension/preemption policy
+            prof.mark("admission")
         (live_ids, sl, live_g, lengths, tables, last_np, stop, samp_g,
          gid_np, aid_np) = self._gather_decode_rows()
         g_iter, spec_lens = self._spec_plan(live_ids)
@@ -1993,6 +2033,8 @@ class PagedInferenceServer:
         lora = self.adapters.device_args() if use_lora else None
         aid = jnp.asarray(aid_np)
         sl_dev = None if sl is None else jnp.asarray(sl)
+        if prof is not None:
+            prof.mark("build")
         if g_iter > 0:
             lim_dev = (None if spec_lens is None else jnp.asarray(
                 self._pad_limits(spec_lens, int(live_g.shape[0]))))
@@ -2026,9 +2068,13 @@ class PagedInferenceServer:
                 # accrue probe credit
                 self.spec_control.on_plain_dispatch(
                     [int(s) for s in live_ids], n)
+        if prof is not None:
+            prof.mark("device")
         self._commit_decode_rows(live_ids, toks, lps, counts, lens, last,
                                  self._drafted_rows(g_iter, spec_lens,
                                                     len(live_ids)))
+        if prof is not None:
+            prof.mark("commit")
 
     def _commit_decode_rows(self, live_ids, toks, lps, counts, lens,
                             last, drafted=None) -> None:
@@ -2181,6 +2227,11 @@ class PagedInferenceServer:
             take = min(int(job.rem_lens[0]) - job.done,
                        self._rem_buckets[0])
             sel = [(job, take)]
+        prof = self._profiler
+        if prof is not None:
+            # budget/round planning, chain extension, QoS funding
+            # order, selection — the host deciding WHAT to dispatch
+            prof.mark("admission")
         if not sel and not n_rounds:
             return
         if self.qos is not None:
@@ -2273,6 +2324,11 @@ class PagedInferenceServer:
         use_grammar = bool(((self._gid > 0) & (live | sel_mask)).any())
         use_lora = bool(((self._aid > 0) & (live | sel_mask)).any())
 
+        if prof is not None:
+            # host array prep done; the dispatch statement below (arg
+            # transfer + launch) through the sanctioned device_get is
+            # the device phase
+            prof.mark("build")
         self.state, ptoks, plps, lens, last, (toks, lps, counts) = \
             _mixed_step(
                 self.params, self.state, jnp.asarray(chunk),
@@ -2310,6 +2366,8 @@ class PagedInferenceServer:
         # step lock that serializes the scheduler by design
         ptoks, plps, toks, lps, counts, lens, last = jax.device_get(
             (ptoks, plps, toks, lps, counts, lens, last))
+        if prof is not None:
+            prof.mark("device")
 
         if n_rounds > 0:
             if (g_iter == 0 and self.spec_drafts > 0
@@ -2349,6 +2407,8 @@ class PagedInferenceServer:
                               float(job.lps[0])):
                     self._finish(sid)
             self._jobs.remove(job)
+        if prof is not None:
+            prof.mark("commit")
 
     # -- scheduler ----------------------------------------------------------
 
@@ -2371,15 +2431,31 @@ class PagedInferenceServer:
         admission in flight, prefill chunks and decode rows fuse into
         ONE token-budget dispatch (stall-free); otherwise (steady state,
         or the alternating scheduler) prefill chunks and a multi-round
-        decode dispatch run separately. Thread-safe."""
+        decode dispatch run separately. Thread-safe.
+
+        With the iteration profiler enabled (the default) every phase
+        boundary is stamped (`sweep` / `admission` here; `build` /
+        `device` / `commit` inside the dispatch paths; `epilogue` in
+        _record_iteration) and the iteration's t0 is the profiler's —
+        so a busy flight record's `duration_ms` covers the WHOLE
+        iteration and equals `host_ms + device_wait_ms` exactly.
+        Disabled, the historical two-read clock (dispatch start →
+        epilogue) is byte-identical."""
         with self._step_lock:
             self.tracer.step_start()
+            prof = self._profiler
             try:
+                if prof is not None:
+                    prof.begin()
                 self._sweep_cancelled()
+                if prof is not None:
+                    prof.mark("sweep")
                 self._start_admissions()
+                if prof is not None:
+                    prof.mark("admission")
                 self._iter_stats = {}
                 p0 = self.preemptions
-                t0 = time.perf_counter()
+                t0 = prof.t0 if prof is not None else time.perf_counter()
                 if self._mixed_enabled and self._jobs:
                     self._mixed_dispatch()
                 else:
@@ -2388,6 +2464,10 @@ class PagedInferenceServer:
                     if self.active.any():
                         self._decode_dispatch()
                 self._record_iteration(t0, p0)
+                if self._iter_stats:
+                    self.last_busy_ts = self._iter_stats["ts"]
+                else:
+                    self.idle_iterations += 1
                 return self.num_active
             finally:
                 self.tracer.step_end()
@@ -2439,8 +2519,23 @@ class PagedInferenceServer:
                 for k, v in self.qos.fair_shares().items()}
         st["n_jobs"] = len(self._jobs)
         st["pending"] = self.num_pending
-        now = time.perf_counter()
-        st["duration_ms"] = (now - t0) * 1e3
+        prof = self._profiler
+        if prof is not None:
+            # everything since the commit mark (the stats assembly
+            # above, fair-share scans included) is epilogue; the mark
+            # doubles as the iteration's closing clock read
+            now = prof.mark("epilogue")
+            phases = prof.phases_ms()
+            st["t_start"] = t0
+            st["phases_ms"] = phases
+            st["duration_ms"] = (now - t0) * 1e3
+            st.update(derive_gap_fields(phases, st["duration_ms"]))
+            hists = self._phase_hists
+            for p, v in phases.items():
+                hists[p].observe(v)
+        else:
+            now = time.perf_counter()
+            st["duration_ms"] = (now - t0) * 1e3
         st["ts"] = time.time()
         self.flight.record(**st)
         if spans:
@@ -2478,6 +2573,15 @@ class PagedInferenceServer:
         reg.counter("preemptions_total",
                     "Lifetime on-demand-paging preemptions").set_total(
                         self.preemptions)
+        # idle-vs-dead disambiguation: an idle scheduler keeps
+        # incrementing the counter while the gauge ages; a dead one
+        # freezes both
+        reg.counter("idle_iterations_total",
+                    "step() calls that dispatched nothing").set_total(
+                        self.idle_iterations)
+        reg.gauge("last_busy_ts",
+                  "Unix time of the last busy iteration (0 until the "
+                  "first)").set(self.last_busy_ts)
         reg.counter("spec_tokens_drafted_total",
                     "Draft tokens proposed on committing rows' behalf"
                     ).set_total(self.spec_tokens_drafted)
@@ -2522,6 +2626,16 @@ class PagedInferenceServer:
         and /stats source; ReplicatedRouter merges these across
         replicas)."""
         return self.metrics.registry.snapshot()
+
+    def iteration_profile_stats(self) -> dict | None:
+        """The /stats `iteration_profile` summary: per-phase
+        count/mean/p50/p99 ms + the aggregate host-gap fraction,
+        computed from the per-phase histograms (so behind the router
+        the same helper over the fleet-merged snapshot reports true
+        fleet percentiles). None with profiling disabled."""
+        from cloud_server_tpu.inference.iteration_profile import (
+            profile_summary)
+        return profile_summary(self.metrics_snapshot())
 
     def speculation_stats(self) -> dict:
         """The /stats `speculation` summary. Counts are fleet-mergeable

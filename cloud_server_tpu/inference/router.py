@@ -191,7 +191,15 @@ class ReplicatedRouter:
         merge is wrong for RATIO gauges: `tenant_fair_share` (1.0 =
         exactly fair) would read ~N for N fair replicas, so it is
         recomputed from the fleet-merged generated totals
-        (tenant_stats), the same rule that function documents."""
+        (tenant_stats), the same rule that function documents.
+
+        The iteration-phase histograms (`iter_phase_ms`, labeled by
+        phase) merge bucket-for-bucket like every other histogram —
+        identical ms ladders by construction — and the derived
+        `host_gap_frac` is deliberately NOT a registered gauge: the
+        /stats summary recomputes it from the merged phase sums
+        (iteration_profile.profile_summary), so the ratio can never
+        be added across replicas by accident."""
         from cloud_server_tpu.utils.serving_metrics import merge_snapshots
         merged = merge_snapshots(
             r.metrics_snapshot() for r in self.replicas

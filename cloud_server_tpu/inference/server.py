@@ -627,7 +627,8 @@ class InferenceServer:
                  prefix_tokens: Sequence[int] | None = None,
                  prefix_remainder_cap: int = 1024,
                  metrics: ServingMetrics | None = None,
-                 qos=None, tracing=None, slo=None):
+                 qos=None, tracing=None, slo=None,
+                 iteration_profile=None):
         # Serving never needs f32 master weights: pre-cast float32 leaves to
         # the compute dtype once, instead of streaming 2x the bytes and
         # converting on every decode step. QTensor leaves stay quantized
@@ -711,6 +712,27 @@ class InferenceServer:
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.metrics.registry.add_collector(self._collect_metrics)
         self.tracer = _StepTracer()  # /debug/trace on-demand profiling
+        # iteration-phase profiler (inference/iteration_profile.py):
+        # sweep/admission/build/device/commit/epilogue clock marks at
+        # host moments the scheduler already crosses — zero extra
+        # dispatches/syncs. The contiguous server has no flight
+        # recorder, so phases feed only the per-phase histograms
+        # (which is also where /stats' `iteration_profile` summary and
+        # host_gap_frac come from). None (disabled) short-circuits
+        # every guarded call site.
+        from cloud_server_tpu.inference.iteration_profile import (
+            register_phase_hists, resolve_profiler)
+        self._profiler = resolve_profiler(iteration_profile,
+                                          infer_cfg.iteration_profile)
+        self._phase_hists = ({} if self._profiler is None else
+                             register_phase_hists(self.metrics.registry))
+        # idle-vs-dead disambiguation (see the paged server): an idle
+        # scheduler keeps incrementing idle_iterations while
+        # last_busy_ts ages; a dead one freezes both
+        self.idle_iterations = 0
+        self.last_busy_ts = 0.0
+        self._iter_busy = False  # scheduler-thread scratch (under
+        #                          _step_lock): did this step dispatch?
         # backpressure: submit() past this bound raises QueueFullError
         # (HTTP 429); None = unbounded (library use, trusted callers)
         self.max_pending = max_pending
@@ -926,7 +948,15 @@ class InferenceServer:
                 group.append((slot, req))
         if not group:
             return
-        now = time.perf_counter()  # one clock read per admission burst
+        self._iter_busy = True
+        if self._profiler is not None:
+            # QoS/DRR group selection under the lock; the burst's
+            # padding/dispatch below stamps build/device/commit. The
+            # mark's timestamp doubles as the admit moment below — one
+            # clock read serves both
+            now = self._profiler.mark("admission")
+        else:
+            now = time.perf_counter()  # one read per admission burst
         for _, req in group:
             self.metrics.observe_admit(req, now)
         prefixed, plain = [], []
@@ -1042,13 +1072,20 @@ class InferenceServer:
         self._ensure_penalty_state(group)
         samp_rows, use_rows, use_bias = self._group_rows(
             group, rows.shape[0])
+        prof = self._profiler
+        if prof is not None:
+            prof.mark("build")
         self.state, toks, lps = run_fn(
             jnp.asarray(rows), jnp.asarray(true_lens), jnp.asarray(slots),
             jax.tree.map(jnp.asarray, samp_rows), use_rows, use_bias)
         toks, lps = jax.device_get((toks, lps))
+        if prof is not None:
+            prof.mark("device")
         for i, (slot, req) in enumerate(group):
             if self._emit(req, int(toks[i]), float(lps[i])):
                 self._finish(slot, req)
+        if prof is not None:
+            prof.mark("commit")
 
     def _admit_group_plain(self, group) -> None:
         def run(rows, tl, sl, samp, use_rows, use_bias):
@@ -1104,18 +1141,45 @@ class InferenceServer:
         """
         with self._step_lock:
             self.tracer.step_start()
+            prof = self._profiler
             try:
-                return self._step_locked()
+                if prof is not None:
+                    prof.begin()
+                self._iter_busy = False
+                n_active = self._step_locked()
+                if self._iter_busy:
+                    if prof is not None:
+                        # epilogue = the post-commit tail of the step;
+                        # phases feed the rolling histograms (the
+                        # contiguous server's only phase sink)
+                        prof.mark("epilogue")
+                        hists = self._phase_hists
+                        for p, v in prof.phases_ms().items():
+                            hists[p].observe(v)
+                    self.last_busy_ts = time.time()
+                else:
+                    self.idle_iterations += 1
+                return n_active
             finally:
                 self.tracer.step_end()
 
     def _step_locked(self) -> int:
+        prof = self._profiler
         self._sweep_cancelled()
+        if prof is not None:
+            prof.mark("sweep")
         self._admit_pending()
         if self.num_active == 0:
             return 0
+        self._iter_busy = True
         n = self._chunk_len()
         use_rows, use_bias = self._rows_mode()
+        if prof is not None:
+            # decode planning; the dispatch statements below (arg
+            # transfer + launch + the sanctioned device_get) are the
+            # device phase — the contiguous decode stages no host
+            # arrays, so its build phase is empty by construction
+            prof.mark("admission")
         if n == 1:
             self.state, out = _decode(
                 self.params, self.state, self._next_rng(),
@@ -1132,12 +1196,16 @@ class InferenceServer:
             toks, lps = jax.device_get(out)
             chunk = np.asarray(toks)             # (n, B)
             lchunk = np.asarray(lps)
+        if prof is not None:
+            prof.mark("device")
         for t in range(chunk.shape[0]):
             for slot, req in enumerate(self._slots):
                 if req is not None and self._emit(
                         req, int(chunk[t, slot]),
                         float(lchunk[t, slot])):
                     self._finish(slot, req)
+        if prof is not None:
+            prof.mark("commit")
         return self.num_active
 
     def _fail_all(self, exc: BaseException) -> None:
@@ -1170,6 +1238,13 @@ class InferenceServer:
         reg.counter("tokens_emitted_total",
                     "Lifetime generated tokens").set_total(
                         self.tokens_emitted)
+        # idle-vs-dead disambiguation (mirrors the paged server)
+        reg.counter("idle_iterations_total",
+                    "step() calls that dispatched nothing").set_total(
+                        self.idle_iterations)
+        reg.gauge("last_busy_ts",
+                  "Unix time of the last busy iteration (0 until the "
+                  "first)").set(self.last_busy_ts)
         reg.counter("prefix_hits_total",
                     "Admissions served from the cached prefix"
                     ).set_total(self.prefix_hits)
@@ -1186,6 +1261,13 @@ class InferenceServer:
         and /stats source; ReplicatedRouter merges these across
         replicas)."""
         return self.metrics.registry.snapshot()
+
+    def iteration_profile_stats(self) -> dict | None:
+        """The /stats `iteration_profile` summary (see the paged
+        server's docstring). None with profiling disabled."""
+        from cloud_server_tpu.inference.iteration_profile import (
+            profile_summary)
+        return profile_summary(self.metrics_snapshot())
 
     @property
     def ready(self) -> bool:

@@ -28,10 +28,14 @@
 # group with the other serving-stack heavies,
 # tests/test_spec_control.py (adaptive speculation: controller law,
 # the mixed+draft-spec+adaptive dispatch-count clone, /stats merge)
-# rides [s-z] with test_speculative.py, and tests/test_analysis.py
+# rides [s-z] with test_speculative.py, tests/test_analysis.py
 # (the stdlib-only static-analysis gate: hot-path lint +
 # lock-discipline + dispatch-discipline, see docs/analysis.md) rides
-# [a-f]. The suite is also runnable standalone:
+# [a-f], and tests/test_iteration_profile.py (the scheduler phase
+# clock: overhead/clock-read guard, flight-record phase split,
+# /debug/scheduler_trace Perfetto export + span cross-links, idle
+# visibility, fleet merge) rides [g-o]. The suite is also runnable
+# standalone:
 #   python -m cloud_server_tpu.analysis [--json] [--checker <id>]
 MARK=(-m "not slow")
 if [ "$1" = "--all" ]; then

@@ -113,6 +113,44 @@ def test_checker_flags_bad_spec_control_paths():
             f"{qual}: {[str(f) for f in findings]}"
 
 
+def test_registry_covers_iteration_profile():
+    """The iteration-phase profiler's record path runs at every phase
+    boundary of every scheduler iteration — the tightest loop on the
+    roster — and the module must stay jax-free (it is consulted from
+    both servers' step loops)."""
+    quals = set(
+        HOT_PATHS["cloud_server_tpu/inference/iteration_profile.py"])
+    for needed in ("IterationProfiler.begin", "IterationProfiler.mark",
+                   "IterationProfiler.phases_ms", "derive_gap_fields"):
+        assert needed in quals, f"{needed} dropped from HOT_PATHS"
+    assert ("cloud_server_tpu/inference/iteration_profile.py"
+            in dispatch.HOST_POLICY_MODULES), \
+        "iteration_profile.py dropped from the DD3 host-policy roster"
+
+
+def test_checker_flags_bad_profile_paths():
+    """Fixture round-trip proving the checker is LIVE on the new
+    module's violation shapes: wall-clock phase stamps, numpy buffers
+    per mark, a blocking sync 'for honest device timing', logging and
+    I/O per iteration — each must fire; the pure passed-timestamp
+    shape the real profiler uses must not."""
+    src = (_FIXTURES / "hot_path_profile_bad.py").read_text()
+    cases = {
+        "BadProfiler.mark_wall_clock": "time.time",
+        "BadProfiler.mark_numpy": "numpy",
+        "BadProfiler.mark_synced": "sync",
+        "BadProfiler.finish_logged": "logging",
+        "BadProfiler.finish_io": "I/O",
+    }
+    for qual, needle in cases.items():
+        findings = check_source("hot_path_profile_bad.py", src, (qual,))
+        assert findings, f"{qual}: expected a finding"
+        assert any(needle in f.message for f in findings), \
+            f"{qual}: {[str(f) for f in findings]}"
+    assert not check_source("hot_path_profile_bad.py", src,
+                            ("BadProfiler.mark_fine",))
+
+
 def test_checker_accepts_clean_fixture():
     src = (_FIXTURES / "hot_path_good.py").read_text()
     findings = check_source("hot_path_good.py", src,

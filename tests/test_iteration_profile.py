@@ -1,0 +1,457 @@
+"""Iteration-phase profiler: phase-clock semantics, flight-record
+phase splits (host_ms + device_wait_ms == duration_ms), the overhead
+guard (the profiling-enabled mixed iteration stays ONE dispatch / ONE
+sync, with a bounded CONSTANT number of profiler clock reads), the
+/debug/scheduler_trace Perfetto export and its cross-link to request
+span trees by iteration index, idle-iteration visibility, and the
+fleet merge of the per-phase histograms."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from cloud_server_tpu.config import InferConfig, ModelConfig
+from cloud_server_tpu.inference import iteration_profile as ip
+from cloud_server_tpu.inference.iteration_profile import (
+    PHASES, IterationProfiler, derive_gap_fields, profile_summary,
+    resolve_profiler, scheduler_chrome_trace)
+from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+from cloud_server_tpu.inference.router import ReplicatedRouter
+from cloud_server_tpu.inference.server import InferenceServer
+from cloud_server_tpu.models import transformer
+
+CFG = ModelConfig(
+    vocab_size=64, embed_dim=32, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=8, mlp_dim=64, max_seq_len=256, dtype="float32",
+    param_dtype="float32", remat="none")
+GREEDY = InferConfig(max_decode_len=8, temperature=0.0, eos_token_id=-1,
+                     pad_token_id=0)
+PAGED_KW = dict(max_slots=4, max_context=64, page_size=8, prefill_chunk=16,
+                prompt_buckets=[16, 48])
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+# ---------------------------------------------------------------------------
+# phase-clock semantics (no server, injected clock)
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_marks_accumulate_and_partition(monkeypatch):
+    """mark(phase) attributes the time since the previous mark;
+    repeated marks ACCUMULATE; the per-phase sum equals the span from
+    t0 to the last mark exactly (no time dropped or double-counted)."""
+    ticks = iter([10.0, 10.5, 11.0, 14.0, 14.25, 15.25, 15.5])
+    monkeypatch.setattr(ip, "perf_counter", lambda: next(ticks))
+    p = IterationProfiler()
+    assert p.begin() == 10.0 and p.t0 == 10.0
+    p.mark("sweep")                 # 0.5 s
+    p.mark("build")                 # 0.5 s
+    p.mark("device")                # 3.0 s
+    p.mark("build")                 # 0.25 s more build (accumulates)
+    p.mark("device")                # 1.0 s more device
+    last = p.mark("commit")         # 0.25 s
+    phases = p.phases_ms()
+    assert list(phases) == ["sweep", "build", "device", "commit"]
+    assert phases["build"] == pytest.approx(750.0)
+    assert phases["device"] == pytest.approx(4000.0)
+    assert sum(phases.values()) == pytest.approx((last - p.t0) * 1e3)
+    # begin() resets for the next iteration
+    ticks2 = iter([20.0, 21.0])
+    monkeypatch.setattr(ip, "perf_counter", lambda: next(ticks2))
+    p.begin()
+    p.mark("device")
+    assert p.phases_ms() == {"device": pytest.approx(1000.0)}
+
+
+def test_derive_gap_fields():
+    d = derive_gap_fields({"sweep": 1.0, "admission": 2.0, "device": 7.0},
+                          10.0)
+    assert d["host_ms"] == pytest.approx(3.0)
+    assert d["device_wait_ms"] == pytest.approx(7.0)
+    assert d["host_gap_frac"] == pytest.approx(0.3)
+    assert derive_gap_fields({}, 0.0)["host_gap_frac"] == 0.0
+
+
+def test_resolve_profiler_forms():
+    assert resolve_profiler(False) is None
+    assert resolve_profiler("off") is None
+    assert resolve_profiler(None, cfg_enabled=False) is None
+    assert isinstance(resolve_profiler(None, cfg_enabled=True),
+                      IterationProfiler)
+    assert isinstance(resolve_profiler(True, cfg_enabled=False),
+                      IterationProfiler)
+    ready = IterationProfiler()
+    assert resolve_profiler(ready) is ready
+    with pytest.raises(ValueError):
+        resolve_profiler(3)
+
+
+def test_config_knob_validates():
+    assert InferConfig(iteration_profile=False).iteration_profile is False
+    assert InferConfig().iteration_profile is True
+
+
+# ---------------------------------------------------------------------------
+# flight-record phase split on live servers
+# ---------------------------------------------------------------------------
+
+
+def _churn(srv, n_first=2, long_len=40):
+    """A small mixed-churn run: warm decodes, then a long prompt whose
+    chunked admission spans several iterations."""
+    first = [srv.submit([5 + i, 9, 3], max_new_tokens=8)
+             for i in range(n_first)]
+    srv.step()
+    long = srv.submit([(k * 7) % 60 + 1 for k in range(long_len)],
+                      max_new_tokens=4)
+    srv.run_until_idle()
+    return first + [long]
+
+
+def test_flight_records_carry_phase_split(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY, scheduler="mixed",
+                               **PAGED_KW)
+    reqs = _churn(srv)
+    assert all(r.done for r in reqs)
+    window = srv.flight_window()
+    assert window
+    for rec in window:
+        phases = rec["phases_ms"]
+        assert set(phases) <= set(PHASES)
+        assert all(v >= 0.0 for v in phases.values())
+        # the acceptance identity: the phase split PARTITIONS the
+        # iteration — host + device-wait reassemble duration exactly
+        assert rec["host_ms"] + rec["device_wait_ms"] == pytest.approx(
+            rec["duration_ms"], rel=1e-9, abs=1e-6)
+        assert 0.0 <= rec["host_gap_frac"] <= 1.0
+        assert rec["t_start"] > 0.0
+        # a busy mixed iteration crossed every boundary
+        assert "device" in phases and "epilogue" in phases
+    # per-phase histograms observed once per busy iteration
+    snap = srv.metrics_snapshot()
+    dev = snap['cloud_server_iter_phase_ms{phase="device"}']
+    assert dev["type"] == "histogram"
+    assert dev["count"] == srv.flight.iterations
+    summary = srv.iteration_profile_stats()
+    assert set(summary["phases"]) <= set(PHASES)
+    assert 0.0 <= summary["host_gap_frac"] <= 1.0
+
+
+def test_alternating_scheduler_phase_split(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY,
+                               scheduler="alternating", **PAGED_KW)
+    reqs = _churn(srv)
+    assert all(r.done for r in reqs)
+    for rec in srv.flight_window():
+        assert rec["host_ms"] + rec["device_wait_ms"] == pytest.approx(
+            rec["duration_ms"], rel=1e-9, abs=1e-6)
+
+
+def test_profiler_disabled_keeps_old_shape(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY, iteration_profile=False,
+                               **PAGED_KW)
+    # same churn shape as the enabled test: the profiler changes no
+    # dispatch shapes, so the jit cache is shared either way
+    reqs = _churn(srv)
+    assert all(r.done for r in reqs)
+    for rec in srv.flight_window():
+        assert "phases_ms" not in rec and "host_gap_frac" not in rec
+        assert rec["duration_ms"] >= 0.0
+    assert not [k for k in srv.metrics_snapshot() if "iter_phase" in k]
+    assert srv.iteration_profile_stats() is None
+
+
+def test_contiguous_server_feeds_phase_histograms(params):
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=2, max_len=64,
+                          prompt_buckets=[16])
+    srv.generate([[5, 9, 3], [7, 2]], max_new_tokens=4)
+    snap = srv.metrics_snapshot()
+    for phase in ("sweep", "admission", "device", "commit", "epilogue"):
+        entry = snap[f'cloud_server_iter_phase_ms{{phase="{phase}"}}']
+        assert entry["count"] > 0, phase
+    summary = srv.iteration_profile_stats()
+    assert summary is not None and 0.0 <= summary["host_gap_frac"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# overhead guard: one dispatch, one sync, bounded constant clock reads
+# ---------------------------------------------------------------------------
+
+
+def test_profiled_mixed_step_dispatch_sync_and_clock_counts(
+        params, monkeypatch):
+    """The profiling-enabled clone of the `_mixed_step` dispatch/
+    device_get-count regression test, plus the profiler's own budget:
+    phase stamping performs a bounded CONSTANT number of perf_counter
+    reads per mixed iteration (begin + one mark per boundary — the
+    count must not scale with slots, jobs, or tokens)."""
+    from cloud_server_tpu.inference import paged_server as ps
+    srv = PagedInferenceServer(params, CFG, GREEDY, scheduler="mixed",
+                               iteration_profile=True, **PAGED_KW)
+    warm = srv.submit([5, 9, 3, 1], max_new_tokens=24)
+    srv.step()
+    assert srv.num_active == 1
+
+    calls = {"mixed": 0, "get": 0, "clock": 0}
+    orig_mixed = ps._mixed_step
+    orig_get = jax.device_get
+    orig_clock = ip.perf_counter
+
+    def mixed_wrap(*a, **k):
+        calls["mixed"] += 1
+        return orig_mixed(*a, **k)
+
+    def get_wrap(x):
+        calls["get"] += 1
+        return orig_get(x)
+
+    def clock_wrap():
+        calls["clock"] += 1
+        return orig_clock()
+
+    monkeypatch.setattr(ps, "_mixed_step", mixed_wrap)
+    monkeypatch.setattr(jax, "device_get", get_wrap)
+    # counts ONLY the profiler's reads: the module binds perf_counter
+    # as a module global, so every begin/mark goes through this
+    monkeypatch.setattr(ip, "perf_counter", clock_wrap)
+
+    long = srv.submit([(k * 7) % 60 + 1 for k in range(40)],
+                      max_new_tokens=4)
+    churn_steps = 0
+    clock_per_step = set()
+    while srv._jobs or srv.num_pending:
+        before = dict(calls)
+        srv.step()
+        churn_steps += 1
+        assert calls["mixed"] - before["mixed"] == 1, \
+            "profiled mixed iteration must stay ONE fused dispatch"
+        assert calls["get"] - before["get"] == 1, \
+            "profiled mixed iteration must stay ONE host sync"
+        clock_per_step.add(calls["clock"] - before["clock"])
+        assert churn_steps < 50
+    assert churn_steps >= 2  # real churn: admission spanned iterations
+    # bounded constant: begin + sweep + admission(step) +
+    # admission(dispatch) + build + device + commit + epilogue = 8
+    assert len(clock_per_step) == 1, (
+        f"profiler clock reads varied across mixed iterations: "
+        f"{clock_per_step}")
+    assert clock_per_step.pop() <= 8
+    monkeypatch.setattr(ps, "_mixed_step", orig_mixed)
+    monkeypatch.setattr(jax, "device_get", orig_get)
+    monkeypatch.setattr(ip, "perf_counter", orig_clock)
+    srv.run_until_idle()
+    assert warm.done and long.done
+
+
+# ---------------------------------------------------------------------------
+# idle-iteration visibility
+# ---------------------------------------------------------------------------
+
+
+def test_idle_vs_busy_visibility(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY, **PAGED_KW)
+    for _ in range(3):
+        srv.step()
+    snap = srv.metrics_snapshot()
+    assert snap["cloud_server_idle_iterations_total"]["value"] == 3
+    assert snap["cloud_server_last_busy_ts"]["value"] == 0.0
+    srv.submit([5, 9, 3], max_new_tokens=3)
+    srv.run_until_idle()
+    snap = srv.metrics_snapshot()
+    assert snap["cloud_server_last_busy_ts"]["value"] > 0.0
+    # the gauge matches the newest flight record's wall-clock stamp
+    assert snap["cloud_server_last_busy_ts"]["value"] == \
+        srv.flight_window()[-1]["ts"]
+    busy_before = srv.flight.iterations
+    srv.step()  # idle again: counter moves, gauge freezes
+    snap2 = srv.metrics_snapshot()
+    assert snap2["cloud_server_idle_iterations_total"]["value"] == 4
+    assert snap2["cloud_server_last_busy_ts"]["value"] == \
+        snap["cloud_server_last_busy_ts"]["value"]
+    assert srv.flight.iterations == busy_before
+
+
+def test_idle_visibility_contiguous(params):
+    srv = InferenceServer(params, CFG, GREEDY, max_slots=2, max_len=64,
+                          prompt_buckets=[16])
+    srv.step()
+    snap = srv.metrics_snapshot()
+    assert snap["cloud_server_idle_iterations_total"]["value"] == 1
+    assert snap["cloud_server_last_busy_ts"]["value"] == 0.0
+    srv.generate([[5, 9, 3]], max_new_tokens=3)
+    assert srv.metrics_snapshot()[
+        "cloud_server_last_busy_ts"]["value"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# scheduler Perfetto export + cross-link to request span trees
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_chrome_trace_wellformed(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY, scheduler="mixed",
+                               **PAGED_KW)
+    reqs = _churn(srv)
+    assert all(r.done for r in reqs)
+    window = srv.flight_window()
+    trace = scheduler_chrome_trace(window)
+    assert json.loads(json.dumps(trace)) == trace  # JSON-serializable
+    events = trace["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert metas, "process/thread name metadata missing"
+    iters = [e for e in xs if e["tid"] == 0]
+    phases = [e for e in xs if e["tid"] > 0]
+    assert len(iters) == len(window)
+    # iteration indices agree with flight_window()
+    assert [e["args"]["iteration"] for e in iters] == \
+        [rec["iteration"] for rec in window]
+    by_iter = {e["args"]["iteration"]: e for e in iters}
+    for e in phases:
+        assert e["name"] in PHASES
+        it = by_iter[e["args"]["iteration"]]
+        # phase events nest within their iteration's bounds (µs; the
+        # 1 µs slack absorbs float accumulation on a large timebase)
+        assert e["ts"] >= it["ts"] - 1.0
+        assert e["ts"] + e["dur"] <= it["ts"] + it["dur"] + 1.0
+    # every recorded phase of every record rendered
+    want = sum(len([v for v in rec["phases_ms"].values() if v > 0])
+               for rec in window)
+    assert len(phases) == want
+
+
+def test_scheduler_trace_skips_unprofiled_records(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY,
+                               iteration_profile=False, **PAGED_KW)
+    srv.submit([5, 9, 3], max_new_tokens=3)
+    srv.run_until_idle()
+    trace = scheduler_chrome_trace(srv.flight_window())
+    assert trace["traceEvents"] == []
+
+
+def test_cross_link_span_to_iteration_roundtrip(params):
+    """The two-way answer: a traced request's decode_segment span
+    carries an iteration index; the flight record with that index
+    frames the span exactly (same t0/now pair), and the Perfetto
+    export's iteration event agrees."""
+    srv = PagedInferenceServer(params, CFG, GREEDY, scheduler="mixed",
+                               tracing=1.0, **PAGED_KW)
+    reqs = _churn(srv)
+    assert all(r.done for r in reqs)
+    window = srv.flight_window()
+    by_iter = {rec["iteration"]: rec for rec in window}
+    trees = srv.trace_trees()
+    assert len(trees) == len(reqs)
+    segs = [s for t in trees for ph in t["root"]["children"]
+            for s in ph.get("children", ())
+            if s["name"] in ("decode_segment", "prefill_chunk")]
+    assert segs, "no iteration-granular spans recorded"
+    linked = 0
+    for s in segs:
+        idx = s["tags"]["iteration"]
+        rec = by_iter.get(idx)
+        if rec is None:
+            continue  # evicted from the ring — index still valid
+        linked += 1
+        # the span shares the iteration's (t0, now) frame
+        assert s["start"] == pytest.approx(rec["t_start"], abs=1e-9)
+        assert s["end"] == pytest.approx(
+            rec["t_start"] + rec["duration_ms"] * 1e-3, abs=1e-6)
+    assert linked, "no span linked to a retained flight record"
+    # and the reverse hop through the Perfetto export
+    trace = scheduler_chrome_trace(window)
+    iter_ev = {e["args"]["iteration"]: e
+               for e in trace["traceEvents"]
+               if e["ph"] == "X" and e["tid"] == 0}
+    s = next(s for s in segs if s["tags"]["iteration"] in iter_ev)
+    e = iter_ev[s["tags"]["iteration"]]
+    assert e["ts"] == pytest.approx(s["start"] * 1e6, rel=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# /stats + /debug/scheduler_trace over HTTP
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def frontend(params):
+    from cloud_server_tpu.inference.http_server import HttpFrontend
+    srv = PagedInferenceServer(params, CFG, GREEDY, **PAGED_KW).start()
+    front = HttpFrontend(srv).start()
+    yield front, srv
+    front.stop()
+    srv.stop()
+
+
+def _get(front, path: str):
+    host, port = front.address
+    with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_http_stats_and_scheduler_trace(frontend):
+    front, srv = frontend
+    req = srv.submit([5, 9, 3], max_new_tokens=4)
+    srv.run_until_idle()
+    assert req.done
+    status, stats = _get(front, "/stats?n=8")
+    assert status == 200
+    prof = stats["iteration_profile"]
+    assert 0.0 <= prof["host_gap_frac"] <= 1.0
+    assert "device" in prof["phases"]
+    assert "p99_ms" in prof["phases"]["device"]
+    for rec in stats["flight_recorder"]:
+        assert "phases_ms" in rec
+    status, trace = _get(front, "/debug/scheduler_trace?n=8")
+    assert status == 200
+    assert any(e["ph"] == "X" and e["name"] in PHASES
+               for e in trace["traceEvents"])
+    # n junk -> 400; n=0 -> empty, never "everything"
+    try:
+        urllib.request.urlopen(
+            "http://%s:%d/debug/scheduler_trace?n=x" % front.address,
+            timeout=30)
+        assert False, "expected HTTP 400"
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 400
+    status, empty = _get(front, "/debug/scheduler_trace?n=0")
+    assert status == 200 and empty["traceEvents"] == []
+
+
+# ---------------------------------------------------------------------------
+# fleet merge
+# ---------------------------------------------------------------------------
+
+
+def test_router_merges_phase_histograms(params):
+    replicas = [PagedInferenceServer(params, CFG, GREEDY, **PAGED_KW)
+                for _ in range(2)]
+    router = ReplicatedRouter(replicas)
+    for i in range(4):
+        router.submit([5 + i, 9, 3], max_new_tokens=3)
+    router.run_until_idle()
+    key = 'cloud_server_iter_phase_ms{phase="device"}'
+    per_rep = [rep.metrics_snapshot()[key] for rep in replicas]
+    assert all(e["count"] > 0 for e in per_rep), \
+        "placement should spread over both replicas"
+    merged = router.metrics_snapshot()[key]
+    assert merged["count"] == sum(e["count"] for e in per_rep)
+    assert merged["counts"] == [
+        a + b for a, b in zip(per_rep[0]["counts"], per_rep[1]["counts"])]
+    # the fleet summary recomputes the ratio from merged sums
+    fleet = profile_summary(router.metrics_snapshot())
+    host = sum(v["count"] for k, v in fleet["phases"].items())
+    assert host > 0 and 0.0 <= fleet["host_gap_frac"] <= 1.0
+    # router flight windows tag replicas, so the Perfetto export
+    # renders one process per replica
+    trace = scheduler_chrome_trace(router.flight_window(16))
+    pids = {e["pid"] for e in trace["traceEvents"]}
+    assert pids == {0, 1}

@@ -8,6 +8,7 @@ import io
 import json
 import pathlib
 import re
+import time
 import urllib.request
 
 import jax
@@ -465,10 +466,20 @@ def test_access_log_records(frontend):
     front, _, log_stream = frontend
     _get(front, "/healthz")
     _get(front, "/metrics")
-    records = [json.loads(ln) for ln in
-               log_stream.getvalue().splitlines() if ln]
-    access = [r for r in records if r.get("event") == "access"]
-    assert {r["path"] for r in access} >= {"/healthz", "/metrics"}
+    # the client's read completes when the body arrives, which is
+    # BEFORE the handler's finally-block writes the access record —
+    # poll (bounded) instead of racing the server thread
+    deadline = time.perf_counter() + 5.0
+    while True:
+        records = [json.loads(ln) for ln in
+                   log_stream.getvalue().splitlines() if ln]
+        access = [r for r in records if r.get("event") == "access"]
+        if {r["path"] for r in access} >= {"/healthz", "/metrics"}:
+            break
+        assert time.perf_counter() < deadline, (
+            "access records never appeared: "
+            f"{sorted(r['path'] for r in access)}")
+        time.sleep(0.01)
     for r in access:
         assert r["method"] == "GET" and r["status"] == 200
         assert r["duration_ms"] >= 0 and r["request_id"]
