@@ -367,6 +367,111 @@ def serving_bench():
         print(f"[serving_bench] qos_isolation skipped after error: "
               f"{exc!r}", flush=True)
         out["qos_isolation_error"] = repr(exc)[:160]
+    # shared-prefix cache churn under a multi-tenant flood (same guard)
+    try:
+        out.update(_prefix_cache_churn_bench(params_bf16, base,
+                                             infer_cfg))
+    except Exception as exc:  # noqa: BLE001
+        print(f"[serving_bench] prefix_cache_churn skipped after "
+              f"error: {exc!r}", flush=True)
+        out["prefix_cache_churn_error"] = repr(exc)[:160]
+    return out
+
+
+def _prefix_cache_churn_bench(params, base, infer_cfg):
+    """Prefix-cache behavior under multi-tenant churn — the
+    measurement half of ROADMAP item 3 (the policy half, prefix-aware
+    routing + per-tenant quotas, will A/B against these keys as
+    `prefix_cache_speedup`).
+
+    Scenario: two tenants share one SYSTEM PROMPT (a 256-token header,
+    exactly the fleet shape the radix cache exists for) and submit
+    short unique continuations, while a third "flood" tenant streams
+    pairwise-disjoint long prompts through a pool sized so the flood's
+    churn must evict cached chains. Reports the page hit rate, the
+    eviction rate per 1k emitted tokens, and the per-tenant
+    saved-token split — plus asserts the attribution layer end-to-end:
+    the shared header must be the hottest sketch chain, both header
+    tenants must realize savings, and the flood tenant must show up
+    as the eviction FORCER in the forensics matrix."""
+    import dataclasses
+
+    import numpy as np
+
+    from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+
+    cfg = dataclasses.replace(base, decode_attention_impl="pallas")
+    qos_cfg = {"tenants": {"team-a": {}, "team-b": {},
+                           "flood": {"priority": "batch"}}}
+
+    def scenario():
+        # 44 pages x 128 tokens: the 12 disjoint 384-token flood
+        # chains alone (~36 keyed pages) roll the cache over, so the
+        # flood FORCES evictions while the LRU protects the re-hit
+        # shared header — exactly the churn-vs-locality regime item
+        # 3's quota policy will tune
+        srv = PagedInferenceServer(
+            params, cfg, infer_cfg, max_slots=16, max_context=1024,
+            page_size=128, prefill_chunk=256, decode_chunk=8,
+            prompt_buckets=[64, 256, 512], num_pages=44, qos=qos_cfg)
+        rng = np.random.RandomState(7)
+        header = [int(x) for x in rng.randint(1, 30000, size=256)]
+
+        def flood_prompt():
+            return [int(x) for x in rng.randint(1, 30000, size=384)]
+
+        t0 = time.perf_counter()
+        reqs = []
+        for wave in range(4):
+            for tenant in ("team-a", "team-b"):
+                reqs += [srv.submit(header + [100 + wave, i],
+                                    max_new_tokens=32, tenant=tenant)
+                         for i in range(2)]
+            reqs += [srv.submit(flood_prompt(), max_new_tokens=32,
+                                tenant="flood") for _ in range(3)]
+            for _ in range(6):
+                srv.step()
+        srv.run_until_idle()
+        dt = time.perf_counter() - t0
+        total = sum(len(r.tokens) for r in reqs)
+        cs = srv.cache_stats()
+        evictions = srv.allocator.evictions
+        srv.stop()
+        return cs, total, dt, evictions
+
+    scenario()  # warm-up: compile every prefill/decode shape
+    cs, total, dt, evictions = scenario()
+    led = cs["tenants"]
+    # end-to-end attribution asserts (guarded like the churn asserts)
+    assert cs["prefix"]["hit_pages"] > 0, "shared header never hit"
+    assert led["team-a"]["saved_tokens"] > 0, "team-a realized nothing"
+    assert led["team-b"]["saved_tokens"] > 0, "team-b realized nothing"
+    assert cs["top_prefixes"], "hot-prefix sketch is empty"
+    # the 256-token header is 2 pages deep at page_size=128 — it must
+    # be the hottest chain after 16 shared-header admissions
+    assert cs["top_prefixes"][0]["depth"] >= 2, cs["top_prefixes"][0]
+    if evictions:
+        forcers = {f for row in cs["eviction_matrix"].values()
+                   for f in row}
+        assert "flood" in forcers, (
+            f"evictions ran but the flood tenant forced none: "
+            f"{cs['eviction_matrix']}")
+    out = {
+        "cache_hit_rate": cs["prefix"]["hit_rate"],
+        "cache_evictions_per_1k_tok": 1e3 * evictions / max(total, 1),
+        "cache_saved_tokens_team_a": led["team-a"]["saved_tokens"],
+        "cache_saved_tokens_team_b": led["team-b"]["saved_tokens"],
+        "cache_saved_tokens_flood": led["flood"]["saved_tokens"],
+        "cache_evicted_pages_team_a": led["team-a"]["evicted_pages"],
+        "cache_top_prefix_hits": cs["top_prefixes"][0]["hits"],
+        "prefix_churn_tok_s": total / dt,
+    }
+    print(f"[serving_bench] prefix_cache_churn: hit_rate "
+          f"{out['cache_hit_rate']:.3f}, "
+          f"{out['cache_evictions_per_1k_tok']:.1f} evictions/1k tok, "
+          f"saved a/b/flood: {out['cache_saved_tokens_team_a']}/"
+          f"{out['cache_saved_tokens_team_b']}/"
+          f"{out['cache_saved_tokens_flood']}", flush=True)
     return out
 
 

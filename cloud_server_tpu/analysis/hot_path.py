@@ -74,6 +74,22 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "IterationProfiler.phases_ms",
         "derive_gap_fields",
     ),
+    # cache telemetry: the record hooks run inside the allocator's
+    # lookup/alloc/release/evict — i.e. inside _start_admissions /
+    # _extend_chains / _release_slot on every scheduler iteration that
+    # moves pages. The read paths (tenant_stats / top_prefixes /
+    # merge_*) are scrape-path only and deliberately absent; sketch
+    # compaction (_compact) IS on the roster — it runs amortized
+    # inside record_walk and must stay plain dict work.
+    "cloud_server_tpu/inference/cache_telemetry.py": (
+        "CacheTelemetry.record_walk",
+        "CacheTelemetry.record_alloc",
+        "CacheTelemetry.record_release",
+        "CacheTelemetry.record_saved",
+        "CacheTelemetry.record_evict",
+        "CacheTelemetry._compact",
+        "CacheTelemetry._tenant",
+    ),
     # SLO tracking: observe() runs at admit / first-token / emit /
     # finish host moments; report/mirror are scrape-path only
     "cloud_server_tpu/inference/slo.py": (
@@ -109,6 +125,7 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "ReplicatedRouter.metrics_snapshot",
         "ReplicatedRouter.tenant_stats",
         "ReplicatedRouter.speculation_stats",
+        "ReplicatedRouter.cache_stats",
     ),
     "cloud_server_tpu/inference/qos.py": (
         "TokenBucket._refill",

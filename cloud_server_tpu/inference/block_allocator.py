@@ -53,6 +53,8 @@ import collections
 import dataclasses
 import hashlib
 
+from cloud_server_tpu.inference.cache_telemetry import CacheTelemetry
+
 # Root digest for every chain. Chain hashing uses blake2b-128 over
 # (parent_digest, page_tokens) rather than Python's builtin hash():
 # the builtin's int-tuple hash is 64-bit, non-cryptographic, and
@@ -84,6 +86,19 @@ def _root_for(namespace: str) -> bytes:
 
 @dataclasses.dataclass
 class AllocatorStats:
+    """Point-in-time allocator snapshot. Occupancy fields partition
+    the pool (`pages_total == pages_free + pages_cached +
+    pages_active`); the rest are LIFETIME counters. `prefix_hit_pages`
+    counts every page served from the cache across all walks;
+    `prefix_miss_pages` counts one page per walk that BROKE at a miss
+    (the walk stops at the first miss, so un-walked pages are not
+    misses here — per-tenant miss accounting in
+    `cache_telemetry.CacheTelemetry` counts the full un-shared
+    remainder instead). `hits_tokens` is the token value of the hit
+    pages (hit pages x page_size — prefill work the cache absorbed);
+    `namespaces` counts the distinct KV namespaces (base model +
+    per-request LoRA adapters) that ever touched the cache."""
+
     pages_total: int
     pages_free: int
     pages_cached: int   # refcount-0 keyed pages (evictable)
@@ -91,13 +106,16 @@ class AllocatorStats:
     prefix_hit_pages: int = 0
     prefix_miss_pages: int = 0
     evictions: int = 0
+    hits_tokens: int = 0
+    namespaces: int = 0
 
 
 class BlockAllocator:
     """Allocator for a pool of `num_pages` device pages of `page_size`
     tokens. Not thread-safe — callers hold the scheduler lock."""
 
-    def __init__(self, num_pages: int, page_size: int):
+    def __init__(self, num_pages: int, page_size: int,
+                 telemetry: CacheTelemetry | None = None):
         self.num_pages = num_pages
         self.page_size = page_size
         self._free: collections.deque[int] = collections.deque(
@@ -113,6 +131,25 @@ class BlockAllocator:
         self.prefix_hit_pages = 0
         self.prefix_miss_pages = 0
         self.evictions = 0
+        # lifetime flow counters (the flight recorder deltas these per
+        # iteration): fresh pages handed out, pages whose refcount hit 0
+        self.pages_allocated = 0
+        self.pages_released = 0
+        self._namespaces: set[str] = set()
+        # per-page attribution sidecar state (plain fixed-size lists —
+        # O(1) per event): the tenant whose alloc produced the page, and
+        # for KEYED pages the chain position / digest / the iteration it
+        # last became evictable (eviction forensics reads all four)
+        self._owner: list[str | None] = [None] * num_pages
+        self._depth = [0] * num_pages
+        self._digest: list[bytes | None] = [None] * num_pages
+        self._idle_since = [0] * num_pages
+        # attribution / forensics / hot-prefix-sketch ledger
+        # (inference/cache_telemetry.py): always present — the record
+        # hooks are plain dict arithmetic — so library users get the
+        # same observability the paged server surfaces
+        self.telemetry = (telemetry if telemetry is not None
+                          else CacheTelemetry(page_size))
 
     # -- capacity -----------------------------------------------------------
 
@@ -128,31 +165,50 @@ class BlockAllocator:
             pages_cached=len(self._evictable), pages_active=active,
             prefix_hit_pages=self.prefix_hit_pages,
             prefix_miss_pages=self.prefix_miss_pages,
-            evictions=self.evictions)
+            evictions=self.evictions,
+            hits_tokens=self.prefix_hit_pages * self.page_size,
+            namespaces=len(self._namespaces))
 
     # -- allocate / share ---------------------------------------------------
 
-    def _evict_one(self) -> None:
+    def _evict_one(self, forcer: str | None = None) -> None:
+        """Reclaim the LRU refcount-0 keyed page. `forcer` is the
+        tenant whose alloc drained the free list — eviction forensics
+        pairs it with the page's producing tenant (who suffered)."""
         page = next(iter(self._evictable))  # oldest refcount-0 page
         del self._evictable[page]
         del self._cache[self._key_of.pop(page)]
         self._free.append(page)
         self.evictions += 1
+        self.telemetry.record_evict(
+            self._owner[page], forcer,
+            self.telemetry.iteration - self._idle_since[page],
+            self._depth[page], self._digest[page])
+        self._owner[page] = None
+        self._digest[page] = None
+        self._depth[page] = 0
 
-    def alloc(self, n: int) -> list[int] | None:
+    def alloc(self, n: int,
+              tenant: str | None = None) -> list[int] | None:
         """n fresh private pages (refcount 1), evicting cached pages as
-        needed; None (and no side effects) if capacity is short."""
+        needed; None (and no side effects) if capacity is short.
+        `tenant` attributes the pages (and any evictions this alloc
+        forces) for the cache-telemetry ledger."""
         if self.available < n:
             return None
         while len(self._free) < n:
-            self._evict_one()
+            self._evict_one(forcer=tenant)
         pages = [self._free.popleft() for _ in range(n)]
         for p in pages:
             self._ref[p] = 1
+            self._owner[p] = tenant
+        self.pages_allocated += n
+        if n:
+            self.telemetry.record_alloc(tenant, n)
         return pages
 
-    def lookup_prefix(self, prompt: list[int], namespace: str = ""
-                      ) -> tuple[list[int], int]:
+    def lookup_prefix(self, prompt: list[int], namespace: str = "",
+                      tenant: str | None = None) -> tuple[list[int], int]:
         """Walk the prompt's full pages through the prefix cache.
 
         Returns (shared_pages, shared_len_tokens). Each hit page's
@@ -160,9 +216,12 @@ class BlockAllocator:
         page and must release() them. At least one prompt token is always
         left un-shared so admission has a position to produce first-token
         logits from. `namespace` partitions chains whose KV differs for
-        identical tokens (per-request LoRA adapters).
+        identical tokens (per-request LoRA adapters); `tenant`
+        attributes the walk's hits/misses (and the hot-prefix-sketch
+        update) to the requesting tenant's ledger.
         """
         ps = self.page_size
+        self._namespaces.add(namespace)
         shared: list[int] = []
         parent = _root_for(namespace)
         limit = (len(prompt) - 1) // ps  # full pages, leaving >= 1 token
@@ -177,17 +236,27 @@ class BlockAllocator:
             self._evictable.pop(page, None)  # active again
             shared.append(page)
             parent = _chain_digest(*key)
-        return shared, len(shared) * ps
+        hits = len(shared)
+        self.telemetry.record_walk(
+            tenant, hits, limit - hits, len(prompt) - hits * ps,
+            parent if hits else None)
+        if hits:
+            self.telemetry.record_alloc(tenant, hits)  # refs held
+        return shared, hits * ps
 
     # -- release ------------------------------------------------------------
 
     def release(self, pages: list[int], tokens: list[int],
-                namespace: str = "") -> None:
+                namespace: str = "",
+                tenant: str | None = None) -> None:
         """Drop one reference per chain page. Pages reaching refcount 0
         become cached (if they are full pages covered by `tokens` — the
         slot's committed prompt + generated ids) or return to the free
-        list (the partial tail). `namespace` must match the lookup's."""
+        list (the partial tail). `namespace` must match the lookup's;
+        `tenant` the lookup/alloc's (the ledger drops the refs it
+        counted there)."""
         ps = self.page_size
+        self._namespaces.add(namespace)
         parent = _root_for(namespace)
         for i, page in enumerate(pages):
             self._ref[page] -= 1
@@ -202,9 +271,23 @@ class BlockAllocator:
                 # content digest: the chain continues regardless of which
                 # physical page is canonical for this position
                 parent = _chain_digest(*key)
+                if page in self._key_of:
+                    # forensics sidecar for the KEYED page: chain
+                    # position + digest (stamped once — the digest is a
+                    # constant of the content) so an eviction needs no
+                    # re-hash
+                    self._depth[page] = i + 1
+                    self._digest[page] = parent
             if self._ref[page] <= 0:
                 self._ref[page] = 0
+                self.pages_released += 1
                 if page in self._key_of:
                     self._evictable[page] = None
+                    # LRU idle clock: age-at-eviction counts from the
+                    # moment the page LAST became evictable
+                    self._idle_since[page] = self.telemetry.iteration
                 else:
                     self._free.append(page)
+                    self._owner[page] = None
+        if pages:
+            self.telemetry.record_release(tenant, len(pages))

@@ -73,7 +73,9 @@ Endpoints:
                     (?n=K bounds the window, default 64) and — with
                     the iteration profiler on (the default) — an
                     `iteration_profile` summary (per-phase
-                    count/mean/p50/p99 ms + host_gap_frac).
+                    count/mean/p50/p99 ms + host_gap_frac). Paged
+                    backends add a `cache` block (the /debug/cache
+                    payload).
   GET  /debug/scheduler_trace  Chrome-trace/Perfetto export of the
                     flight recorder's recent window (?n=K, default
                     64): one track per scheduler phase (sweep /
@@ -85,6 +87,17 @@ Endpoints:
                     "this request's decode_segment was slow" and
                     "what the scheduler was doing that iteration"
                     (inference/iteration_profile.py).
+  GET  /debug/cache KV-cache & memory observability
+                    (inference/cache_telemetry.py): pool occupancy
+                    split free/cached/active with the evictable
+                    fraction, prefix hit/miss/eviction counts + hit
+                    rate, the per-tenant attribution table (hit /
+                    miss / saved / evicted tokens, pages held), the
+                    hot-prefix top-K sketch, and eviction forensics
+                    (recent ring + victim×forcer matrix). Behind a
+                    ReplicatedRouter counts sum across replicas and
+                    the ratios recompute post-merge. 404 when the
+                    backend has no paged KV cache.
   POST /debug/trace {"steps": N, "logdir": optional} — wrap the next N
                     scheduler iterations in a jax profiler trace
                     (utils.tracing.capture_trace); returns the logdir
@@ -430,6 +443,14 @@ class HttpFrontend:
                             "the request sampled)"})
                     else:
                         self._json(200, tree)
+                elif url.path == "/debug/cache":
+                    fn = getattr(front.srv, "cache_stats", None)
+                    if fn is None:
+                        self._json(404, {"error": "this serving "
+                                         "backend has no paged KV "
+                                         "cache"})
+                        return
+                    self._json(200, fn())
                 elif url.path == "/debug/scheduler_trace":
                     fn = getattr(front.srv, "flight_window", None)
                     if fn is None:
@@ -586,6 +607,13 @@ class HttpFrontend:
         profile = profile_summary(snap)
         if profile is not None:
             payload["iteration_profile"] = profile
+        # KV-cache & memory: pool occupancy, prefix hit rate,
+        # per-tenant attribution, the hot-prefix sketch, and eviction
+        # forensics (cache_telemetry.py). Behind the router the counts
+        # are fleet-merged with ratios recomputed post-merge.
+        cfn = getattr(self.srv, "cache_stats", None)
+        if cfn is not None:
+            payload["cache"] = cfn()
         # speculative decoding: drafted/accepted totals, the accept
         # rate, and (adaptive) the live per-slot draft lengths.
         # ReplicatedRouter's speculation_stats() merges counts across

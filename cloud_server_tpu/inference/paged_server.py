@@ -1100,6 +1100,18 @@ class PagedInferenceServer:
         # registry get-or-create
         self._phase_hists = ({} if self._profiler is None else
                              register_phase_hists(self.metrics.registry))
+        # cache/memory observability (inference/cache_telemetry.py):
+        # the allocator's ledger gets the registry's fixed-ladder
+        # histogram families (chain depth per walk, page age at
+        # eviction, per-iteration evictable fraction) — eager
+        # registration, same rationale as the phase histograms; the
+        # observe paths are a dict lookup + Histogram.observe, zero
+        # dispatches/syncs (the dispatch-count clone covers a
+        # QoS+cache-telemetry server)
+        from cloud_server_tpu.inference.cache_telemetry import (
+            register_cache_hists)
+        self._cache_hists = register_cache_hists(self.metrics.registry)
+        self.allocator.telemetry.attach_hists(self._cache_hists)
         # idle-iteration visibility: a dead scheduler and an idle one
         # must not look identical from /stats — an idle one keeps
         # incrementing idle_iterations while last_busy_ts ages, a dead
@@ -1365,6 +1377,16 @@ class PagedInferenceServer:
             return len(self._pending)
 
     def prefix_cache_stats(self):
+        """Allocator snapshot (AllocatorStats). Called from the scrape
+        path and the router WITHOUT the scheduler locks — audited
+        under the lock-discipline passes (LD1–LD4): every field is
+        derived from plain ints and `len()`s of containers the
+        scheduler thread mutates under `_step_lock`; each read is
+        GIL-atomic, so a snapshot can lag the running iteration by a
+        few pages but can never tear a single value. Taking
+        `_step_lock` here would stall every scrape behind a whole
+        dispatch — the same racy-by-design monitoring trade `num_active`
+        documents."""
         return self.allocator.stats()
 
     # -- internals ----------------------------------------------------------
@@ -1485,7 +1507,8 @@ class PagedInferenceServer:
         what happens to the request afterwards is the caller's story."""
         slot = self._slots[slot_id]
         self.allocator.release(slot.pages, keyed_tokens,
-                               namespace=slot.req.adapter or "")
+                               namespace=slot.req.adapter or "",
+                               tenant=slot.req.tenant)
         self._slots[slot_id] = None
         self.tables[slot_id, :] = self.allocator.num_pages  # sentinel
         self.active[slot_id] = False
@@ -1535,7 +1558,8 @@ class PagedInferenceServer:
                 prompt = list(req.prompt) + list(req.tokens)
                 remaining = req.max_new_tokens - len(req.tokens)
                 shared, shared_len = self.allocator.lookup_prefix(
-                    prompt, namespace=req.adapter or "")
+                    prompt, namespace=req.adapter or "",
+                    tenant=req.tenant)
                 if self.allocation == "ondemand":
                     # prompt + one decode window; chains grow per
                     # dispatch in _extend_chains
@@ -1543,10 +1567,12 @@ class PagedInferenceServer:
                 else:
                     total = len(prompt) + remaining + self.window
                 need = -(-total // self.page_size) - len(shared)
-                fresh = self.allocator.alloc(max(0, need))
+                fresh = self.allocator.alloc(max(0, need),
+                                             tenant=req.tenant)
                 if fresh is None:
                     self.allocator.release(shared, prompt[:shared_len],
-                                           namespace=req.adapter or "")
+                                           namespace=req.adapter or "",
+                                           tenant=req.tenant)
                     if self.num_active == 0 and not self._jobs:
                         # nothing running will ever free pages: the pool
                         # is simply too small for this request
@@ -1566,6 +1592,13 @@ class PagedInferenceServer:
                     # break above leaves it intact for the retry)
                     self.qos.charge_admission(req.tenant, len(prompt))
                     self.qos.on_pending_removed(req.tenant)
+                if shared_len:
+                    # REALIZED prefill savings: recorded only once the
+                    # admission holds its pages (the walk above already
+                    # counted the optimistic hit tokens; a page-famine
+                    # release-and-retry must not double-count savings)
+                    self.allocator.telemetry.record_saved(req.tenant,
+                                                          shared_len)
                 slot_id = free.pop(0)
                 self._admit_seq += 1
                 slot = _Slot(req=req, prompt=prompt,
@@ -1855,7 +1888,9 @@ class PagedInferenceServer:
                 if delta <= 0:
                     break
                 grab = min(delta, self.allocator.available)
-                fresh = self.allocator.alloc(grab) if grab > 0 else None
+                fresh = (self.allocator.alloc(grab,
+                                              tenant=slot.req.tenant)
+                         if grab > 0 else None)
                 if fresh:
                     start = len(slot.pages)
                     slot.pages.extend(fresh)
@@ -2447,6 +2482,14 @@ class PagedInferenceServer:
             try:
                 if prof is not None:
                     prof.begin()
+                al = self.allocator
+                # page-flow baseline for this iteration's flight record
+                # (sweep + admission allocate/release too, so capture
+                # before both) + the telemetry recency stamp: the
+                # flight index THIS iteration will get if it is busy
+                al.telemetry.iteration = self.flight.iterations + 1
+                c0 = (al.pages_allocated, al.pages_released,
+                      al.evictions)
                 self._sweep_cancelled()
                 if prof is not None:
                     prof.mark("sweep")
@@ -2463,7 +2506,7 @@ class PagedInferenceServer:
                         self._run_one_chunk(job)
                     if self.active.any():
                         self._decode_dispatch()
-                self._record_iteration(t0, p0)
+                self._record_iteration(t0, p0, c0)
                 if self._iter_stats:
                     self.last_busy_ts = self._iter_stats["ts"]
                 else:
@@ -2483,7 +2526,8 @@ class PagedInferenceServer:
                     (s.req, "decode_segment",
                      {"slot": int(sid), "rounds": n_rounds}))
 
-    def _record_iteration(self, t0: float, p0: int) -> None:
+    def _record_iteration(self, t0: float, p0: int,
+                          c0: tuple[int, int, int]) -> None:
         """Flight-recorder epilogue for one busy scheduler iteration:
         the dispatch paths filled `_iter_stats` with their token split;
         this adds the budget/occupancy derived fields and appends ONE
@@ -2519,6 +2563,25 @@ class PagedInferenceServer:
                 for k, v in self.qos.fair_shares().items()}
         st["n_jobs"] = len(self._jobs)
         st["pending"] = self.num_pending
+        # KV-pool telemetry (joins phases_ms in the record): the
+        # iteration's page flow (deltas against the step-start
+        # baseline — sweep/admission included) and the occupancy split
+        # at record time. Plain int reads/len()s on state this thread
+        # owns; the evictable-fraction histogram is the HBM-pressure
+        # watermark /metrics carries.
+        al = self.allocator
+        st["pages_allocated"] = al.pages_allocated - c0[0]
+        st["pages_released"] = al.pages_released - c0[1]
+        st["pages_evicted"] = al.evictions - c0[2]
+        free, cached = len(al._free), len(al._evictable)
+        st["pool_free"] = free
+        st["pool_cached"] = cached
+        st["pool_active"] = al.num_pages - free - cached
+        frac = (free + cached) / max(al.num_pages, 1)
+        st["pool_evictable_frac"] = frac
+        h = self._cache_hists.get("evictable_frac")
+        if h is not None:
+            h.observe(frac)
         prof = self._profiler
         if prof is not None:
             # everything since the commit mark (the stats assembly
@@ -2616,7 +2679,60 @@ class PagedInferenceServer:
         reg.counter("prefix_evictions_total",
                     "Prefix-cache pages evicted under memory pressure"
                     ).set_total(stats.evictions)
+        reg.counter("prefix_hit_tokens_total",
+                    "Token value of prefix-cache page hits (prefill "
+                    "work the cache absorbed)").set_total(
+                        stats.hits_tokens)
+        reg.counter("pages_allocated_total",
+                    "Fresh KV pages handed out by the allocator"
+                    ).set_total(self.allocator.pages_allocated)
+        reg.counter("pages_released_total",
+                    "KV pages whose refcount reached zero (cached or "
+                    "freed)").set_total(self.allocator.pages_released)
+        reg.gauge("cache_namespaces",
+                  "Distinct KV namespaces (base model + LoRA "
+                  "adapters) that touched the prefix cache").set(
+                      stats.namespaces)
         if self.qos is not None:
+            # per-tenant cache attribution mirrors, following the QoS
+            # cardinality rule: labeled series exist only when a
+            # TenantRegistry bounds the tenant set (the ledger's keys
+            # are names the registry already resolved). Eager over the
+            # registry's configured tenants — the families exist (and
+            # the docs drift check sees them) before any traffic.
+            tstats = self.allocator.telemetry.tenant_stats()
+            for name in set(self.qos.tenants()) | set(tstats):
+                led = tstats.get(name, {})
+                lbl = {"tenant": name}
+                reg.counter(
+                    "tenant_prefix_hit_tokens_total",
+                    "Prompt tokens served from prefix-cache hits at "
+                    "lookup, per tenant", labels=lbl).set_total(
+                        led.get("hit_tokens", 0))
+                reg.counter(
+                    "tenant_prefix_miss_tokens_total",
+                    "Prompt tokens the cache could not serve "
+                    "(freshly prefilled, tail included), per tenant",
+                    labels=lbl).set_total(
+                        led.get("miss_tokens", 0))
+                reg.counter(
+                    "tenant_prefix_evicted_tokens_total",
+                    "Token value of the tenant's cached chains "
+                    "evicted under memory pressure", labels=lbl
+                    ).set_total(
+                        led.get("evicted_pages", 0) * self.page_size)
+                reg.counter(
+                    "tenant_prefix_saved_tokens_total",
+                    "Prefill tokens the tenant actually skipped at "
+                    "admission (realized savings; diverges from hit "
+                    "tokens exactly when page-famine retries wasted "
+                    "lookups)", labels=lbl).set_total(
+                        led.get("saved_tokens", 0))
+                reg.gauge(
+                    "tenant_cache_pages_held",
+                    "KV pages currently referenced by the tenant's "
+                    "slots (shared pages count once per holder)",
+                    labels=lbl).set(led.get("pages_held", 0))
             self.qos.mirror_metrics(reg)
         if self.slo is not None:
             self.slo.mirror_metrics(reg)
@@ -2661,6 +2777,56 @@ class PagedInferenceServer:
                 str(k): v
                 for k, v in self.spec_control.draft_lengths().items()}
         return out
+
+    def cache_stats(self) -> dict:
+        """The /stats `cache` block and GET /debug/cache source: pool
+        occupancy, lifetime prefix hit/miss/eviction counts with the
+        hit rate, the per-tenant attribution table, the hot-prefix
+        top-K sketch, and the eviction forensics (recent ring +
+        victim×forcer matrix). Counts are fleet-mergeable —
+        `ReplicatedRouter.cache_stats()` sums them and recomputes
+        `hit_rate` / `evictable_frac` from the merged totals via
+        `cache_telemetry.merge_cache_stats` (the `tenant_fair_share`
+        rule: ratios never add). Scrape-path only; same lock-free
+        monitoring reads as `prefix_cache_stats` (see its audit
+        note)."""
+        from cloud_server_tpu.inference.cache_telemetry import hit_rate
+        s = self.allocator.stats()
+        tel = self.allocator.telemetry
+        tstats = tel.tenant_stats()
+        # full-page-granular hit/miss (the ledger counts every
+        # un-shared full prompt page as a miss, where the allocator's
+        # walk counter records one break per walk) — so `hit_rate`
+        # here is the true page hit rate, the number item 3's
+        # prefix-aware routing scores against
+        hit_pages = sum(led["hit_pages"] for led in tstats.values())
+        miss_pages = sum(led["miss_pages"] for led in tstats.values())
+        return {
+            "pool": {
+                "pages_total": s.pages_total,
+                "pages_free": s.pages_free,
+                "pages_cached": s.pages_cached,
+                "pages_active": s.pages_active,
+                "evictable_frac": ((s.pages_free + s.pages_cached)
+                                   / max(s.pages_total, 1)),
+            },
+            "prefix": {
+                "hit_pages": hit_pages,
+                "miss_pages": miss_pages,
+                "hit_tokens": s.hits_tokens,
+                "evictions": s.evictions,
+                "hit_rate": hit_rate(hit_pages, miss_pages),
+            },
+            "namespaces": s.namespaces,
+            # the SAME snapshot the hit/miss aggregate above came
+            # from — a second tenant_stats() could observe newer walks
+            # and ship a payload whose tenants table contradicts its
+            # own prefix block
+            "tenants": tstats,
+            "top_prefixes": tel.top_prefixes(),
+            "recent_evictions": tel.recent_evictions(64),
+            "eviction_matrix": tel.eviction_matrix(),
+        }
 
     @property
     def ready(self) -> bool:

@@ -317,6 +317,26 @@ class ReplicatedRouter:
                                      / max(merged["tokens_drafted"], 1))
         return merged
 
+    def cache_stats(self) -> dict:
+        """FLEET-wide KV-cache/memory view (the /debug/cache and
+        /stats `cache` source behind the router): pool, prefix, and
+        per-tenant COUNTS sum across replicas; `hit_rate` and
+        `evictable_frac` recompute from the merged totals (never
+        added — the `tenant_fair_share` ratio rule); the hot-prefix
+        sketches merge per chain digest (hits sum, so the same system
+        prompt hot on two replicas ranks twice as hot fleet-wide —
+        the artifact ROADMAP item 3(a)'s prefix-aware `_pick` scores
+        against); forensics rings concatenate tagged by replica.
+        Returns {} when no replica exposes cache stats."""
+        from cloud_server_tpu.inference.cache_telemetry import (
+            merge_cache_stats)
+        stats = []
+        for r in self.replicas:
+            fn = getattr(r, "cache_stats", None)
+            if fn is not None:
+                stats.append(fn())
+        return merge_cache_stats(stats)
+
     def lookup_trace(self, request_id: str) -> dict | None:
         """Span tree for one sampled request, wherever it ran: the
         first replica that knows the id answers, tagged with its

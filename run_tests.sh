@@ -31,7 +31,11 @@
 # rides [s-z] with test_speculative.py, tests/test_analysis.py
 # (the stdlib-only static-analysis gate: hot-path lint +
 # lock-discipline + dispatch-discipline, see docs/analysis.md) rides
-# [a-f], and tests/test_iteration_profile.py (the scheduler phase
+# [a-f], tests/test_cache_observability.py (KV-cache & memory
+# observability: per-tenant prefix attribution, eviction forensics,
+# the hot-prefix sketch + its fleet merge, /debug/cache) rides [a-f]
+# with test_block_allocator.py, and tests/test_iteration_profile.py
+# (the scheduler phase
 # clock: overhead/clock-read guard, flight-record phase split,
 # /debug/scheduler_trace Perfetto export + span cross-links, idle
 # visibility, fleet merge) rides [g-o]. The suite is also runnable

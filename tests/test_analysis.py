@@ -151,6 +151,48 @@ def test_checker_flags_bad_profile_paths():
                             ("BadProfiler.mark_fine",))
 
 
+def test_registry_covers_cache_telemetry():
+    """The cache-telemetry record hooks run inside the allocator's
+    lookup/alloc/release/evict — i.e. inside every scheduler iteration
+    that moves pages — and the module must stay jax-free (DD3) since
+    both the allocator and the router's fleet merge consult it."""
+    quals = set(
+        HOT_PATHS["cloud_server_tpu/inference/cache_telemetry.py"])
+    for needed in ("CacheTelemetry.record_walk",
+                   "CacheTelemetry.record_evict",
+                   "CacheTelemetry.record_saved",
+                   "CacheTelemetry._compact"):
+        assert needed in quals, f"{needed} dropped from HOT_PATHS"
+    assert ("cloud_server_tpu/inference/cache_telemetry.py"
+            in dispatch.HOST_POLICY_MODULES), \
+        "cache_telemetry.py dropped from the DD3 host-policy roster"
+    router_quals = set(HOT_PATHS["cloud_server_tpu/inference/router.py"])
+    assert "ReplicatedRouter.cache_stats" in router_quals
+
+
+def test_checker_flags_bad_cache_paths():
+    """Fixture round-trip proving the checker is LIVE on the cache
+    module's violation shapes: wall-clock eviction stamps, numpy
+    buffers per walk, a blocking sync for pool occupancy, logging and
+    I/O per eviction — each must fire; the dict-arithmetic shape the
+    real telemetry uses must not."""
+    src = (_FIXTURES / "hot_path_cache_bad.py").read_text()
+    cases = {
+        "BadCacheTelemetry.record_evict_wall_clock": "time.time",
+        "BadCacheTelemetry.record_walk_numpy": "numpy",
+        "BadCacheTelemetry.record_walk_synced": "sync",
+        "BadCacheTelemetry.record_evict_logged": "logging",
+        "BadCacheTelemetry.record_evict_io": "I/O",
+    }
+    for qual, needle in cases.items():
+        findings = check_source("hot_path_cache_bad.py", src, (qual,))
+        assert findings, f"{qual}: expected a finding"
+        assert any(needle in f.message for f in findings), \
+            f"{qual}: {[str(f) for f in findings]}"
+    assert not check_source("hot_path_cache_bad.py", src,
+                            ("BadCacheTelemetry.record_walk_fine",))
+
+
 def test_checker_accepts_clean_fixture():
     src = (_FIXTURES / "hot_path_good.py").read_text()
     findings = check_source("hot_path_good.py", src,

@@ -88,6 +88,28 @@ def test_eviction_lru_under_pressure():
     a.release(got, [])
 
 
+def test_stats_tokens_and_namespaces():
+    """AllocatorStats carries the token value of the page hits
+    (hit pages x page_size) and the count of distinct KV namespaces
+    that touched the cache — and the flow counters feed the flight
+    recorder's per-iteration deltas."""
+    a = BlockAllocator(8, page_size=4)
+    st = a.stats()
+    assert st.hits_tokens == 0 and st.namespaces == 0
+    p = a.alloc(2)
+    a.release(p, toks(8))                      # base namespace ""
+    shared, n = a.lookup_prefix(toks(9))
+    assert len(shared) == 2 and n == 8
+    a.release(shared, toks(8))
+    q = a.alloc(1)
+    a.release(q, toks(4, base=50), namespace="lora-a")
+    st = a.stats()
+    assert st.hits_tokens == 8 == st.prefix_hit_pages * 4
+    assert st.namespaces == 2                  # "" and "lora-a"
+    assert a.pages_allocated == 3              # fresh pages handed out
+    assert a.pages_released >= 3               # refcounts that hit 0
+
+
 def test_chain_key_requires_matching_parent():
     """Same page tokens under a different prefix must NOT hit."""
     a = BlockAllocator(8, page_size=2)

@@ -1,0 +1,351 @@
+"""KV-cache & memory observability (inference/cache_telemetry.py):
+per-tenant prefix-cache attribution, eviction forensics (victim vs
+forcer), the bounded hot-prefix sketch, flight-recorder pool
+telemetry, the /debug/cache endpoint, and the fleet merge (counts
+sum, ratios recompute post-merge)."""
+
+import json
+import urllib.request
+
+import jax
+import pytest
+
+from cloud_server_tpu.config import InferConfig, ModelConfig
+from cloud_server_tpu.inference.block_allocator import BlockAllocator
+from cloud_server_tpu.inference.cache_telemetry import (
+    DEFAULT_TENANT, CacheTelemetry, hit_rate, merge_cache_stats,
+    merge_top_prefixes)
+from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+from cloud_server_tpu.inference.router import ReplicatedRouter
+from cloud_server_tpu.models import transformer
+
+CFG = ModelConfig(
+    vocab_size=64, embed_dim=32, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=8, mlp_dim=64, max_seq_len=256, dtype="float32",
+    param_dtype="float32", remat="none")
+GREEDY = InferConfig(max_decode_len=8, temperature=0.0, eos_token_id=-1,
+                     pad_token_id=0)
+PAGED_KW = dict(max_slots=4, max_context=64, page_size=8, prefill_chunk=16,
+                prompt_buckets=[16, 48])
+QOS = {"tenants": {"a": {}, "b": {}}}
+
+# a 16-token shared header (2 full pages at page_size=8) + unique tails
+HEADER = [7, 3, 9, 1, 4, 8, 2, 6, 5, 11, 13, 17, 19, 23, 29, 31]
+
+
+def prompt_with_tail(k):
+    return HEADER + [40 + k, 41 + k, 42 + k]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+# ---------------------------------------------------------------------------
+# telemetry primitives
+# ---------------------------------------------------------------------------
+
+
+def test_default_tenant_matches_qos():
+    """cache_telemetry deliberately does not import qos (import-chain
+    weight); the two DEFAULT_TENANT constants must stay equal so the
+    ledger keys line up with the registry's resolved names."""
+    from cloud_server_tpu.inference.qos import (
+        DEFAULT_TENANT as QOS_DEFAULT)
+    assert DEFAULT_TENANT == QOS_DEFAULT
+
+
+def test_sketch_bounded_topk_and_compaction():
+    tel = CacheTelemetry(page_size=4, top_k=2, capacity=4)
+    tel.iteration = 1
+    hot = b"\x01" * 16
+    for _ in range(10):
+        tel.record_walk("a", 3, 0, 5, hot)
+    # flood with one-hit chains: the table must stay bounded and the
+    # hot chain must survive every compaction with its exact count
+    for i in range(50):
+        tel.iteration = 2 + i
+        tel.record_walk("a", 1, 1, 9, bytes([2 + i]) * 16)
+    top = tel.top_prefixes()
+    assert len(top) == 2  # top_k bounds the export
+    assert top[0]["key"] == hot.hex()
+    assert top[0]["hits"] == 10 and top[0]["depth"] == 3
+    assert top[0]["last_hit_iteration"] == 1
+    assert len(tel.top_prefixes(100)) <= 4  # capacity bounds the table
+    with pytest.raises(ValueError):
+        CacheTelemetry(page_size=4, top_k=4, capacity=4)
+
+
+def test_merge_top_prefixes_sums_overlap():
+    a = [{"key": "aa", "depth": 2, "hits": 5, "last_hit_iteration": 9},
+         {"key": "bb", "depth": 1, "hits": 2, "last_hit_iteration": 3}]
+    b = [{"key": "aa", "depth": 2, "hits": 4, "last_hit_iteration": 7},
+         {"key": "cc", "depth": 3, "hits": 3, "last_hit_iteration": 1}]
+    merged = merge_top_prefixes([a, b], k=2)
+    assert merged[0] == {"key": "aa", "depth": 2, "hits": 9,
+                         "last_hit_iteration": 9}
+    assert merged[1]["key"] == "cc" and len(merged) == 2
+
+
+def test_merge_cache_stats_recomputes_ratios():
+    """Two half-hitting replicas merge to hit_rate 0.5, never 1.0 —
+    the ratio recomputes from the summed counts."""
+    def replica(hits, misses, free, cached, total):
+        return {"pool": {"pages_total": total, "pages_free": free,
+                         "pages_cached": cached,
+                         "pages_active": total - free - cached,
+                         "evictable_frac": (free + cached) / total},
+                "prefix": {"hit_pages": hits, "miss_pages": misses,
+                           "hit_tokens": hits * 8, "evictions": 1,
+                           "hit_rate": hit_rate(hits, misses)},
+                "namespaces": 1,
+                "tenants": {"a": {"hit_pages": hits, "saved_tokens": 3}},
+                "top_prefixes": [], "recent_evictions": [{"victim": "a"}],
+                "eviction_matrix": {"a": {"b": 2}}}
+
+    r1, r2 = replica(4, 4, 2, 2, 8), replica(1, 1, 8, 0, 8)
+    merged = merge_cache_stats([r1, r2])
+    assert merged["prefix"]["hit_pages"] == 5
+    assert merged["prefix"]["miss_pages"] == 5
+    assert merged["prefix"]["hit_rate"] == pytest.approx(0.5)
+    assert merged["prefix"]["hit_rate"] != pytest.approx(
+        r1["prefix"]["hit_rate"] + r2["prefix"]["hit_rate"])
+    # evictable_frac recomputes over the merged pool (12/16), never
+    # the sum of per-replica fractions (0.5 + 1.0)
+    assert merged["pool"]["evictable_frac"] == pytest.approx(12 / 16)
+    assert merged["tenants"]["a"] == {"hit_pages": 5, "saved_tokens": 6}
+    assert merged["eviction_matrix"] == {"a": {"b": 4}}
+    assert [e["replica"] for e in merged["recent_evictions"]] == [0, 1]
+    assert len(merged["per_replica"]) == 2
+    assert merge_cache_stats([]) == {}
+
+
+# ---------------------------------------------------------------------------
+# allocator attribution + forensics (host-only, no model)
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_tenant_attribution_and_forensics():
+    a = BlockAllocator(6, page_size=4)
+    a.telemetry.iteration = 5
+    pa = a.alloc(2, tenant="a")
+    a.release(pa, list(range(8)), tenant="a")  # keys 2 pages for "a"
+    shared, n = a.lookup_prefix(list(range(9)), tenant="a")
+    assert len(shared) == 2 and n == 8
+    a.telemetry.record_saved("a", n)  # what the scheduler does
+    a.release(shared, list(range(8)), tenant="a")
+    a.telemetry.iteration = 9
+    assert a.alloc(6, tenant="b") is not None  # forces both evictions
+    led = a.telemetry.tenant_stats()
+    assert led["a"]["hit_pages"] == 2
+    assert led["a"]["hit_tokens"] == 8
+    assert led["a"]["saved_tokens"] == 8
+    assert led["a"]["miss_tokens"] == 1  # the un-shared tail token
+    assert led["a"]["evicted_pages"] == 2  # suffered
+    assert led["a"]["pages_held"] == 0
+    assert led["b"]["evictions_caused"] == 2
+    assert led["b"]["pages_held"] == 6
+    assert a.telemetry.eviction_matrix() == {"a": {"b": 2}}
+    recs = a.telemetry.recent_evictions()
+    assert len(recs) == 2
+    for rec in recs:
+        assert rec["victim"] == "a" and rec["forcer"] == "b"
+        assert rec["age_iterations"] == 4  # idle since iteration 5
+        assert rec["key"]
+    assert sorted(r["depth"] for r in recs) == [1, 2]
+    # stats() carries the new satellite fields
+    st = a.stats()
+    assert st.hits_tokens == st.prefix_hit_pages * 4 == 8
+    assert st.namespaces == 1
+
+
+def test_saved_diverges_from_hit_on_famine_retry():
+    """hit_tokens counts at LOOKUP (optimistic); saved_tokens only
+    when the admission realized the win — a famine release-and-retry
+    double-counts the former, never the latter."""
+    a = BlockAllocator(4, page_size=4)
+    p = a.alloc(2, tenant="a")
+    a.release(p, list(range(8)), tenant="a")
+    for _ in range(2):  # two walks: first "fails" admission, retries
+        shared, n = a.lookup_prefix(list(range(9)), tenant="a")
+        a.release(shared, list(range(8)), tenant="a")
+    a.telemetry.record_saved("a", n)  # only the second one admitted
+    led = a.telemetry.tenant_stats()["a"]
+    assert led["hit_tokens"] == 16 and led["saved_tokens"] == 8
+
+
+def test_unattributed_callers_land_on_default_ledger():
+    a = BlockAllocator(4, page_size=4)
+    p = a.alloc(2)
+    a.release(p, list(range(8)))
+    shared, _ = a.lookup_prefix(list(range(9)))
+    a.release(shared, list(range(8)))
+    led = a.telemetry.tenant_stats()
+    assert set(led) == {DEFAULT_TENANT}
+    assert led[DEFAULT_TENANT]["hit_pages"] == 2
+
+
+# ---------------------------------------------------------------------------
+# live paged server
+# ---------------------------------------------------------------------------
+
+
+def _flood(srv, tenant, n, base=0):
+    reqs = [srv.submit(prompt_with_tail(base + i), max_new_tokens=4,
+                       tenant=tenant) for i in range(n)]
+    srv.run_until_idle()
+    return reqs
+
+
+def test_live_server_attribution_and_pool_telemetry(params):
+    """ONE live multi-tenant server exercises the whole layer:
+    shared-header hits attribute to both tenants, then a flooding
+    tenant on the 10-page pool evicts the quiet tenants' chains —
+    attribution, pool flight telemetry, forensics, and the scrape
+    mirrors all come from the same traffic (tier-1 pays one server)."""
+    srv = PagedInferenceServer(params, CFG, GREEDY, qos=QOS,
+                               num_pages=10, **PAGED_KW)
+    _flood(srv, "a", 2)       # first requests key the shared header
+    _flood(srv, "a", 2, 10)   # same header -> prefix hits for "a"
+    _flood(srv, "b", 1, 20)   # "b" rides the same header too
+    cs = srv.cache_stats()
+    # pool partition + well-formedness
+    pool = cs["pool"]
+    assert (pool["pages_free"] + pool["pages_cached"]
+            + pool["pages_active"] == pool["pages_total"])
+    assert 0.0 < pool["evictable_frac"] <= 1.0
+    assert cs["namespaces"] == 1
+    # the shared 2-page header is the hottest chain
+    assert cs["top_prefixes"], "no hot chains after shared-prefix load"
+    assert cs["top_prefixes"][0]["depth"] == 2
+    assert cs["prefix"]["hit_pages"] > 0
+    assert cs["prefix"]["hit_rate"] == hit_rate(
+        cs["prefix"]["hit_pages"], cs["prefix"]["miss_pages"])
+    led = cs["tenants"]
+    assert led["a"]["saved_tokens"] >= 16  # 2 pages x 8 tokens, twice
+    assert led["b"]["saved_tokens"] >= 16  # cross-tenant page sharing
+    assert led["a"]["pages_held"] == 0  # everything released when idle
+    # scrape-path mirrors: labeled per-tenant families + hists
+    snap = srv.metrics_snapshot()
+    assert snap[
+        'cloud_server_tenant_prefix_saved_tokens_total{tenant="a"}'][
+            "value"] == led["a"]["saved_tokens"]
+    assert snap[
+        'cloud_server_tenant_prefix_hit_tokens_total{tenant="b"}'][
+            "value"] == led["b"]["hit_tokens"]
+    assert snap["cloud_server_prefix_hit_tokens_total"]["value"] > 0
+    assert snap["cloud_server_cache_chain_depth_pages"]["count"] > 0
+    assert snap["cloud_server_pool_evictable_frac"]["count"] > 0
+    assert snap["cloud_server_pages_allocated_total"]["value"] > 0
+    # flight records carry the per-iteration page flow + occupancy
+    recs = srv.flight_window()
+    assert recs
+    for rec in recs:
+        assert (rec["pool_free"] + rec["pool_cached"]
+                + rec["pool_active"] == pool["pages_total"])
+        assert rec["pages_allocated"] >= 0
+        assert rec["pages_released"] >= 0
+        assert rec["pages_evicted"] >= 0
+    assert any(rec["pages_allocated"] > 0 for rec in recs)
+    assert any(rec["pages_released"] > 0 for rec in recs)
+    # -- eviction forensics on the same server: "b" floods the tiny
+    # pool with pairwise-DISJOINT prompts, evicting "a"'s cached
+    # header chain — forensics must name victim AND forcer
+    for i in range(4):
+        srv.submit([(50 + i * 29 + j * 3) % 60 + 1 for j in range(24)],
+                   max_new_tokens=6, tenant="b")
+        srv.run_until_idle()
+    cs = srv.cache_stats()
+    assert srv.allocator.evictions > 0
+    led = cs["tenants"]
+    assert led["b"]["evictions_caused"] > 0
+    assert led["a"]["evicted_pages"] > 0, (
+        "the quiet tenant's chains survived a pool 10 pages small")
+    assert cs["eviction_matrix"]["a"]["b"] > 0
+    assert any(r["victim"] == "a" and r["forcer"] == "b"
+               for r in cs["recent_evictions"])
+    for r in cs["recent_evictions"]:
+        assert r["age_iterations"] >= 0 and r["depth"] >= 1
+    assert srv.metrics_snapshot()[
+        "cloud_server_cache_page_age_at_eviction_iters"]["count"] > 0
+
+
+def test_fleet_merge_is_exact(params):
+    """Two live replicas with OVERLAPPING tenants and one shared-hot
+    chain: the fleet top-K sums the common chain's hits across
+    replicas, keeps each replica's disjoint chains, and recomputes
+    the hit-rate ratio from the merged counts."""
+    reps = [PagedInferenceServer(params, CFG, GREEDY, qos=QOS,
+                                 **PAGED_KW) for _ in range(2)]
+    # the SAME header goes hot on both replicas (overlap); each also
+    # gets a disjoint hot chain via a different second prompt family
+    alt = [[60 - i for i in range(16)] + [33, 34, 35],
+           [30 + i for i in range(16)] + [36, 37, 38]]
+    for i, rep in enumerate(reps):
+        for _ in range(2 + i):  # asymmetric: replica 1 hits once more
+            for r in [rep.submit(prompt_with_tail(0), max_new_tokens=4,
+                                 tenant="a"),
+                      rep.submit(alt[i], max_new_tokens=4, tenant="b")]:
+                pass
+            rep.run_until_idle()
+    singles = [rep.cache_stats() for rep in reps]
+    router = ReplicatedRouter(reps)
+    fleet = router.cache_stats()
+    # counts sum exactly
+    for field in ("hit_pages", "miss_pages", "hit_tokens", "evictions"):
+        assert fleet["prefix"][field] == sum(
+            s["prefix"][field] for s in singles), field
+    assert fleet["prefix"]["hit_rate"] == pytest.approx(hit_rate(
+        fleet["prefix"]["hit_pages"], fleet["prefix"]["miss_pages"]))
+    for t in ("a", "b"):
+        for k in ("hit_tokens", "saved_tokens", "evicted_pages"):
+            assert fleet["tenants"][t][k] == sum(
+                s["tenants"][t][k] for s in singles), (t, k)
+    # the common chain merged: fleet hits == replica hits summed
+    by_key = {e["key"]: e for e in fleet["top_prefixes"]}
+    common = [{e["key"] for e in s["top_prefixes"]} for s in singles]
+    overlap = common[0] & common[1]
+    assert overlap, "shared header chain missing from a replica sketch"
+    for key in overlap:
+        want = sum(next(e["hits"] for e in s["top_prefixes"]
+                        if e["key"] == key) for s in singles)
+        assert by_key[key]["hits"] == want
+    # each replica's disjoint chain survives the merge
+    assert (common[0] | common[1]) <= set(by_key)
+    assert len(fleet["per_replica"]) == 2
+    # /metrics behind the router: labeled cache counters sum additively
+    merged_snap = router.metrics_snapshot()
+    key = 'cloud_server_tenant_prefix_saved_tokens_total{tenant="a"}'
+    assert merged_snap[key]["value"] == sum(
+        s["tenants"]["a"]["saved_tokens"] for s in singles)
+
+
+def test_debug_cache_endpoint(params):
+    from cloud_server_tpu.inference.http_server import HttpFrontend
+    srv = PagedInferenceServer(params, CFG, GREEDY, qos=QOS,
+                               **PAGED_KW).start()
+    front = HttpFrontend(srv).start()
+    try:
+        host, port = front.address
+        _flood(srv, "a", 2)
+        _flood(srv, "a", 2, 10)
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/debug/cache", timeout=60) as resp:
+            cache = json.loads(resp.read())
+        assert set(cache) >= {"pool", "prefix", "tenants",
+                              "top_prefixes", "recent_evictions",
+                              "eviction_matrix", "namespaces"}
+        assert cache["prefix"]["hit_pages"] > 0
+        assert cache["tenants"]["a"]["saved_tokens"] > 0
+        assert all(isinstance(e["key"], str)
+                   for e in cache["top_prefixes"])
+        # /stats carries the same payload as a `cache` block
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/stats?n=4", timeout=60) as resp:
+            stats = json.loads(resp.read())
+        assert stats["cache"]["prefix"]["hit_pages"] == \
+            cache["prefix"]["hit_pages"]
+    finally:
+        front.stop()
+        srv.stop()

@@ -286,8 +286,12 @@ _TRACING_SLO_KW = {
                                     "e2e_s": 120.0}}}}
 
 
-@pytest.mark.parametrize("extra_kw", [{}, _TRACING_SLO_KW],
-                         ids=["plain", "tracing_slo"])
+_QOS_CACHE_KW = {"qos": {"tenants": {"a": {}, "b": {}}}}
+
+
+@pytest.mark.parametrize("extra_kw",
+                         [{}, _TRACING_SLO_KW, _QOS_CACHE_KW],
+                         ids=["plain", "tracing_slo", "qos_cache"])
 def test_mixed_step_dispatch_and_sync_count(params, monkeypatch,
                                             extra_kw):
     """The instrumented mixed-scheduler iteration still issues exactly
@@ -297,7 +301,11 @@ def test_mixed_step_dispatch_and_sync_count(params, monkeypatch,
     SAME invariant with per-request tracing at 100% head sampling AND
     SLO tracking enabled: span recording and burn-rate accounting are
     host-side list/int work on already-owned timestamps, zero
-    dispatches or syncs."""
+    dispatches or syncs. The `qos_cache` clone runs it with a
+    multi-tenant registry live, so the per-tenant CACHE attribution
+    path (cache_telemetry record hooks inside every allocator
+    lookup/alloc/release) is pinned to zero added dispatches/syncs
+    too."""
     from cloud_server_tpu.inference import paged_server as ps
     srv = PagedInferenceServer(params, CFG, GREEDY, scheduler="mixed",
                                **PAGED_KW, **extra_kw)
@@ -341,10 +349,16 @@ def test_mixed_step_dispatch_and_sync_count(params, monkeypatch,
     assert warm.done and long.done
     assert srv.metrics_snapshot()[
         "cloud_server_requests_finished_total"]["value"] == 2
-    if extra_kw:  # the clone really ran with both layers live
+    if "tracing" in extra_kw:  # the clone really ran with both live
         assert len(srv.trace_trees()) == 2
         assert srv.slo_report()["classes"]["default"]["metrics"][
             "e2e"]["lifetime"]["total"] == 2
+    if "qos" in extra_kw:  # the cache-attribution path really ran
+        cs = srv.cache_stats()
+        assert cs["tenants"]  # walks were recorded per tenant
+        assert (cs["pool"]["pages_free"] + cs["pool"]["pages_cached"]
+                + cs["pool"]["pages_active"]
+                == cs["pool"]["pages_total"])
 
 
 # ---------------------------------------------------------------------------
@@ -460,6 +474,15 @@ def test_metrics_exposition_wellformed_over_http(frontend):
     _assert_exposition_wellformed(text)
     assert "cloud_server_ttft_seconds_bucket" in text
     assert "cloud_server_pages_free" in text
+    # KV-cache & memory families (cache_telemetry.py) ride the same
+    # exposition: eager-registered histograms + allocator counters
+    assert "cloud_server_cache_chain_depth_pages_bucket" in text
+    assert "cloud_server_pool_evictable_frac_bucket" in text
+    assert "cloud_server_prefix_hit_tokens_total" in text
+    # /debug/cache is well-formed JSON over the same backend
+    cache = json.loads(_get(front, "/debug/cache"))
+    assert set(cache) >= {"pool", "prefix", "tenants", "top_prefixes",
+                          "recent_evictions", "eviction_matrix"}
 
 
 def test_access_log_records(frontend):
