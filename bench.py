@@ -375,6 +375,120 @@ def serving_bench():
         print(f"[serving_bench] prefix_cache_churn skipped after "
               f"error: {exc!r}", flush=True)
         out["prefix_cache_churn_error"] = repr(exc)[:160]
+    # fleet fault recovery: kill one replica mid-flood (same guard)
+    try:
+        out.update(_fault_recovery_bench(params_bf16, base, infer_cfg))
+    except Exception as exc:  # noqa: BLE001
+        print(f"[serving_bench] fault_recovery skipped after error: "
+              f"{exc!r}", flush=True)
+        out["fault_recovery_error"] = repr(exc)[:160]
+    return out
+
+
+def _fault_recovery_bench(params, base, infer_cfg):
+    """Fleet fault recovery A/B (docs/serving.md "Fault tolerance"):
+    a 2-replica router floods 16 requests; the injected arm arms a
+    deterministic dispatch fault on replica 0 a few iterations in —
+    its scheduler crashes exactly like a poisoned device program —
+    and the run reports how the failure-domain layer absorbed it:
+
+      * `fault_recovery_time_to_breaker_open_ms` — injected fault ->
+        replica-0 breaker open (placement stops routing there);
+      * `fault_recovery_retry_success_rate` — zero-token failed
+        requests resubmitted to replica 1 that completed normally
+        (the safe-retry rule; partially-streamed requests fail fast
+        by design and land in completed_frac instead);
+      * `fault_recovery_{baseline,injected}_completed_frac` and
+        `..._slo_ttft` — the client-visible blast radius vs the
+        uninjected control at identical load.
+
+    Both arms run twice (untimed compile warm-up, then measured),
+    like the churn benches."""
+    import dataclasses
+
+    import numpy as np
+
+    from cloud_server_tpu.inference.faults import FaultPlan
+    from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+    from cloud_server_tpu.inference.router import ReplicatedRouter
+
+    cfg = dataclasses.replace(base, decode_attention_impl="pallas")
+    slo_cfg = {"windows_s": [300],
+               "classes": {"default": {"objective": 0.99, "ttft_s": 5.0,
+                                       "e2e_s": 600.0}}}
+
+    def scenario(inject: bool):
+        fp = FaultPlan() if inject else None
+
+        def mk(faults):
+            return PagedInferenceServer(
+                params, cfg, infer_cfg, max_slots=8, max_context=1024,
+                page_size=128, prefill_chunk=256, decode_chunk=8,
+                prompt_buckets=[64, 256], slo=slo_cfg, faults=faults)
+
+        router = ReplicatedRouter([mk(fp), mk(None)],
+                                  breaker_threshold=3,
+                                  breaker_reset_s=600.0)
+        rng = np.random.RandomState(0)
+        reqs = [router.submit([int(x) for x in
+                               rng.randint(1, 30000, size=64)],
+                              max_new_tokens=96) for _ in range(16)]
+        for _ in range(4):
+            router.step()
+        t_fault = t_open = None
+        if inject:
+            fp.arm("dispatch", count=1)
+            t_fault = time.perf_counter()
+        deadline = time.perf_counter() + 300
+        while (not all(r.done for r in reqs)
+               and time.perf_counter() < deadline):
+            router.step()
+            if (inject and t_open is None
+                    and router.breaker_states()[0]["state"] == "open"):
+                t_open = time.perf_counter()
+        ok = sum(1 for r in reqs
+                 if r.done
+                 and not (r.finish_reason or "").startswith("error"))
+        rep = router.slo_report()
+        att = (rep["classes"]["default"]["metrics"]
+               .get("ttft", {}).get("lifetime", {}).get("attainment"))
+        snap = router.metrics_snapshot()
+        res = {"completed_frac": ok / len(reqs),
+               "slo_ttft": 1.0 if att is None else att}
+        if inject:
+            res["time_to_breaker_open_ms"] = (
+                -1.0 if t_open is None else (t_open - t_fault) * 1e3)
+            retries = snap["cloud_server_router_retries_total"]["value"]
+            succ = snap["cloud_server_router_retry_success_total"][
+                "value"]
+            res["retries"] = retries
+            res["retry_success_rate"] = succ / max(retries, 1)
+        for r in reqs:
+            r.cancel()
+        router.run_until_idle()
+        router.stop()
+        return res
+
+    out = {}
+    for tag, inject in (("baseline", False), ("injected", True)):
+        scenario(inject)  # warm-up: compile every shape
+        res = scenario(inject)
+        out[f"fault_recovery_{tag}_completed_frac"] = \
+            res["completed_frac"]
+        out[f"fault_recovery_{tag}_slo_ttft"] = res["slo_ttft"]
+        if inject:
+            out["fault_recovery_time_to_breaker_open_ms"] = \
+                res["time_to_breaker_open_ms"]
+            out["fault_recovery_retries"] = res["retries"]
+            out["fault_recovery_retry_success_rate"] = \
+                res["retry_success_rate"]
+        print(f"[serving_bench] fault_recovery_{tag}: completed "
+              f"{res['completed_frac']:.2f}, slo_ttft "
+              f"{res['slo_ttft']:.3f}"
+              + (f", breaker open in "
+                 f"{res['time_to_breaker_open_ms']:.1f} ms, retry "
+                 f"success {res['retry_success_rate']:.2f}"
+                 if inject else ""), flush=True)
     return out
 
 

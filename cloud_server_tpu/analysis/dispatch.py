@@ -127,6 +127,7 @@ SANCTIONED_SYNCS: dict[str, tuple[str, ...]] = {
 # telemetry. The servers are the only modules allowed to touch jax.
 HOST_POLICY_MODULES: tuple[str, ...] = (
     "cloud_server_tpu/inference/qos.py",
+    "cloud_server_tpu/inference/faults.py",
     "cloud_server_tpu/inference/slo.py",
     "cloud_server_tpu/inference/request_trace.py",
     "cloud_server_tpu/inference/spec_control.py",

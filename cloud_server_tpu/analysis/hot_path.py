@@ -90,6 +90,22 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "CacheTelemetry._compact",
         "CacheTelemetry._tenant",
     ),
+    # failure-domain layer: FaultPlan.fire/check run per guarded site
+    # hit on the scheduler iteration and submit paths (a plan that
+    # stalls the scheduler by ACCIDENT would corrupt the very recovery
+    # measurements it exists for — maybe_stall/maybe_wedge, whose JOB
+    # is blocking, are deliberately absent); the OverloadDetector's
+    # observe runs once per busy iteration and level/shed/retry_hint
+    # gate every submit
+    "cloud_server_tpu/inference/faults.py": (
+        "FaultPlan.fire",
+        "FaultPlan.check",
+        "OverloadDetector.observe",
+        "OverloadDetector._effective_locked",
+        "OverloadDetector.level",
+        "OverloadDetector.shed",
+        "OverloadDetector.retry_hint",
+    ),
     # SLO tracking: observe() runs at admit / first-token / emit /
     # finish host moments; report/mirror are scrape-path only
     "cloud_server_tpu/inference/slo.py": (
@@ -137,6 +153,7 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "TenantRegistry.priority_rank",
         "TenantRegistry.priority_class",
         "TenantRegistry.weight",
+        "TenantRegistry.default_deadline",
         "TenantRegistry.victim_rank",
         "TenantRegistry._decay_recent",
         "TenantRegistry.gate_submit",

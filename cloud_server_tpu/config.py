@@ -254,6 +254,26 @@ class InferConfig:
     # arguments; servers parse it at construction. Constructor
     # argument `slo=` overrides.
     slo_config: str = ""
+    # Deterministic fault injection (inference/faults.py): a JSON
+    # object as a string, or a path to a JSON file, arming named fault
+    # sites (submit_reject / dispatch / iteration_stall / wedge /
+    # alloc_famine) with seeded after/count/p windows — the lever that
+    # makes every recovery path (router failover, breakers, _fail_all)
+    # provable instead of aspirational. "" (the default) disables
+    # injection entirely: every guarded call site short-circuits and
+    # the schedulers run the byte-identical pre-fault paths (pinned by
+    # the dispatch/device_get-count regression clones). A string keeps
+    # this dataclass hashable for jit static arguments; servers parse
+    # it at construction. Constructor argument `faults=` overrides.
+    fault_plan: str = ""
+    # Overload brownout (inference/faults.py): a JSON object as a
+    # string, or a path to a JSON file, with the OverloadDetector
+    # thresholds (pending_age_s / budget_utilization / host_gap_frac
+    # EWMAs), hysteresis, shed sets per level, and the jittered
+    # Retry-After base. "" (the default) disables brownout. Requires a
+    # QoS registry (shed sets are priority classes). Paged server
+    # only; constructor argument `brownout=` overrides.
+    brownout_config: str = ""
 
     def __post_init__(self) -> None:
         if self.scheduler not in ("mixed", "alternating"):

@@ -175,6 +175,24 @@ def build_parser() -> argparse.ArgumentParser:
                    "inference/slo.py). Surfaced via GET /slo and the "
                    "slo_attainment/slo_burn_rate gauges; omitted, SLO "
                    "tracking is disabled entirely")
+    p.add_argument("--fault-plan", metavar="FILE_OR_JSON", default=None,
+                   help="deterministic fault injection: a JSON file "
+                   "path (or inline JSON object) arming named fault "
+                   "sites (submit_reject/dispatch/iteration_stall/"
+                   "wedge/alloc_famine) with seeded after/count/p "
+                   "windows (schema: inference/faults.py). Proves "
+                   "recovery paths — router failover, breakers, "
+                   "_fail_all — against a live server; omitted, "
+                   "injection is disabled entirely")
+    p.add_argument("--brownout", metavar="FILE_OR_JSON", default=None,
+                   help="overload brownout (paged server, needs "
+                   "--qos-config): a JSON file path (or inline JSON "
+                   "object) with OverloadDetector thresholds over "
+                   "pending age / budget utilization / host_gap_frac, "
+                   "hysteresis, and per-level shed classes (schema: "
+                   "inference/faults.py). Sheds best_effort/batch "
+                   "admissions with jittered Retry-After 429s before "
+                   "the interactive SLO burns")
     p.add_argument("--trace-sample-rate", type=float, default=0.0,
                    metavar="RATE",
                    help="per-request distributed tracing: head-based "
@@ -376,6 +394,7 @@ def main(argv=None) -> None:
                 qos=args.qos_config,
                 slo=args.slo_config,
                 tracing=args.trace_sample_rate or None,
+                faults=args.fault_plan,
                 iteration_profile=False if args.no_iteration_profile else None)
         if args.prefix:
             print("[generate] note: the paged server reuses shared "
@@ -408,6 +427,8 @@ def main(argv=None) -> None:
             qos=args.qos_config,
             slo=args.slo_config,
             tracing=args.trace_sample_rate or None,
+            faults=args.fault_plan,
+            brownout=args.brownout,
             iteration_profile=False if args.no_iteration_profile else None,
             tokenizer=tok)  # regex-constrained requests compile vs it
 

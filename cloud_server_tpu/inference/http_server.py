@@ -119,6 +119,19 @@ slot and pages within one step). When the backend is constructed with
 `max_pending`, submissions past the bound return HTTP 429 — clients
 retry instead of growing host memory.
 
+Fault tolerance (docs/serving.md "Fault tolerance"): an
+`X-Deadline-S: <seconds>` header sets the request's deadline (the
+scheduler cancels it once passed; finish_reason "deadline"); overload
+brownout and tenant rate limits both surface as 429s with the
+structured `Retry-After` body (brownout hints carry seeded jitter so
+shed clients do not thundering-herd the recovery). A request that
+FAILS mid-stream ends its stream with `{"error", "retriable"}` —
+`retriable: false` once any token was streamed (resubmitting would
+duplicate output; the router's zero-token failover already exhausted
+every safe retry), and non-streaming 503s carry `retriable: true`.
+Behind a ReplicatedRouter, `/healthz` gains a `replicas` list with
+per-replica circuit-breaker state.
+
 Multi-tenant QoS (inference/qos.py): when the backend carries a
 TenantRegistry, each request's tenant comes from an API key
 (`Authorization: Bearer <key>` / `X-Api-Key`) the registry maps —
@@ -383,6 +396,7 @@ class HttpFrontend:
                 self._tenant = None
                 self._trace_ctx = None
                 self._trace_id = None
+                self._deadline_s = None
                 return time.perf_counter()
 
             def _json(self, code: int, payload: dict,
@@ -409,12 +423,17 @@ class HttpFrontend:
                     # ok = liveness; ready = routability (false while
                     # the backend drains or after stop(), so load
                     # balancers shed this replica without killing its
-                    # in-flight work)
-                    self._json(200, {"ok": True,
-                                     "ready": bool(getattr(
-                                         front.srv, "ready", True)),
-                                     "active": front.srv.num_active,
-                                     "pending": front.srv.num_pending})
+                    # in-flight work). Behind a ReplicatedRouter the
+                    # payload gains per-replica circuit-breaker state.
+                    payload = {"ok": True,
+                               "ready": bool(getattr(
+                                   front.srv, "ready", True)),
+                               "active": front.srv.num_active,
+                               "pending": front.srv.num_pending}
+                    bfn = getattr(front.srv, "breaker_states", None)
+                    if bfn is not None:
+                        payload["replicas"] = bfn()
+                    self._json(200, payload)
                 elif url.path == "/slo":
                     fn = getattr(front.srv, "slo_report", None)
                     rep = fn() if fn is not None else None
@@ -532,6 +551,26 @@ class HttpFrontend:
                 except (ValueError, json.JSONDecodeError) as exc:
                     self._json(400, {"error": str(exc)})
                     return
+                # request deadline: X-Deadline-S seconds from now; the
+                # scheduler sweep cancels the request once it passes
+                # and the router stops failover retries past it.
+                # Validated AFTER the body read: this handler speaks
+                # HTTP/1.1 keep-alive, and a 400 sent with the body
+                # unconsumed would desync the next request on the
+                # connection. `not (x > 0)` so NaN (False both ways)
+                # cannot slip through as a never-expiring deadline.
+                raw_dl = self.headers.get("X-Deadline-S")
+                if raw_dl is not None:
+                    try:
+                        dl = float(raw_dl)
+                        if not (math.isfinite(dl) and dl > 0):
+                            raise ValueError
+                        self._deadline_s = dl
+                    except ValueError:
+                        self._json(400, {
+                            "error": "X-Deadline-S must be a finite "
+                            "positive number of seconds"})
+                        return
                 try:
                     handler(self, body)
                 except (ValueError, TypeError, KeyError,
@@ -555,7 +594,12 @@ class HttpFrontend:
                         headers={"Retry-After":
                                  str(max(1, math.ceil(retry)))})
                 except RuntimeError as exc:  # scheduler stopped/crashed
-                    self._json(503, {"error": str(exc)})
+                    # retriable: true — nothing was delivered to this
+                    # client (streaming failures surface in-stream with
+                    # their own retriable flag), so resubmission is
+                    # safe once a replica recovers
+                    self._json(503, {"error": str(exc),
+                                     "retriable": True})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None
@@ -621,6 +665,23 @@ class HttpFrontend:
         sfn = getattr(self.srv, "speculation_stats", None)
         if sfn is not None:
             payload["speculation"] = sfn()
+        # failure-domain blocks: brownout level/signals and injected-
+        # fault counts, present only when configured (single-server
+        # debug views; the COUNTERS merge fleet-wide via /metrics)
+        bofn = getattr(self.srv, "brownout_stats", None)
+        if bofn is not None:
+            bstats = bofn()
+            if bstats is not None:
+                payload["brownout"] = bstats
+        ffn = getattr(self.srv, "fault_stats", None)
+        if ffn is not None:
+            fstats = ffn()
+            if fstats is not None:
+                payload["faults"] = fstats
+        # router breaker view (behind a ReplicatedRouter)
+        brfn = getattr(self.srv, "breaker_states", None)
+        if brfn is not None:
+            payload["breakers"] = brfn()
         # multi-tenant QoS: per-tenant counters + fair-share view.
         # ReplicatedRouter merges these across replicas
         # (tenant_stats()); a single server reports its registry's.
@@ -716,6 +777,27 @@ class HttpFrontend:
         return {"trace_ctx": ctx} if ctx is not None else {}
 
     @staticmethod
+    def _deadline_kw(handler) -> dict:
+        """submit() kwargs carrying the parsed X-Deadline-S header —
+        empty when the client sent none (same third-party-backend
+        rule as _tenant_kw)."""
+        dl = getattr(handler, "_deadline_s", None)
+        return {"deadline_s": dl} if dl is not None else {}
+
+    @staticmethod
+    def _error_line(request) -> dict | None:
+        """Structured terminal error for a STREAMING response whose
+        request failed: `{"error", "retriable"}`. retriable is False
+        once any token was streamed — the client must not resubmit or
+        it may receive duplicated output (the router's safe-retry rule
+        already exhausted every zero-token recovery before this
+        surfaces). None when the request did not fail."""
+        reason = request.finish_reason or ""
+        if not reason.startswith("error"):
+            return None
+        return {"error": reason, "retriable": not request.tokens}
+
+    @staticmethod
     def _trace_headers(handler, request) -> dict:
         """Response headers for a submitted request: a W3C
         `traceparent` naming its trace (so the caller can stitch
@@ -775,6 +857,7 @@ class HttpFrontend:
             kw["adapter"] = body["adapter"]
         kw.update(self._tenant_kw(handler))
         kw.update(self._trace_kw(handler))
+        kw.update(self._deadline_kw(handler))
         request, q = self._submit_streaming(tokens, max_new, sampling,
                                             **kw)
 
@@ -797,10 +880,19 @@ class HttpFrontend:
                     line["text"] = self.tokenizer.decode([tok])
                 handler.wfile.write((json.dumps(line) + "\n").encode())
                 handler.wfile.flush()
-            handler.wfile.write((json.dumps(
-                {"done": True, "finish_reason": request.finish_reason,
-                 "tokens": request.tokens,
-                 "logprobs": request.logprobs}) + "\n").encode())
+            err = self._error_line(request)
+            if err is not None:
+                # structured terminal error: a partially-streamed
+                # request fails fast with retriable: false (resending
+                # would duplicate the streamed tokens); zero-token
+                # failures are safe to resubmit
+                handler.wfile.write((json.dumps(err) + "\n").encode())
+            else:
+                handler.wfile.write((json.dumps(
+                    {"done": True,
+                     "finish_reason": request.finish_reason,
+                     "tokens": request.tokens,
+                     "logprobs": request.logprobs}) + "\n").encode())
         except (BrokenPipeError, ConnectionResetError):
             # the client went away: stop generating on its behalf — the
             # scheduler frees the slot and pages within one step
@@ -914,7 +1006,7 @@ class HttpFrontend:
             request, q = self._submit_streaming(
                 prompts[0], max_new, sampling,
                 **self._adapter_kw(body), **self._tenant_kw(handler),
-                **self._trace_kw(handler))
+                **self._trace_kw(handler), **self._deadline_kw(handler))
             self._sse_head(handler,
                            self._trace_headers(handler, request))
             stream = _TextStream(self.tokenizer)
@@ -927,10 +1019,16 @@ class HttpFrontend:
                             "choices": [{"text": delta, "index": 0,
                                          "logprobs": None,
                                          "finish_reason": None}]})
-                tail = stream.flush()
-                choice = {"text": tail, "index": 0, "logprobs": None,
-                          "finish_reason": _finish(request.finish_reason)}
-                self._sse(handler, {**base, "choices": [choice]})
+                err = self._error_line(request)
+                if err is not None:
+                    self._sse(handler, {**base, **err})
+                else:
+                    tail = stream.flush()
+                    choice = {"text": tail, "index": 0,
+                              "logprobs": None,
+                              "finish_reason":
+                                  _finish(request.finish_reason)}
+                    self._sse(handler, {**base, "choices": [choice]})
                 handler.wfile.write(b"data: [DONE]\n\n")
                 handler.wfile.flush()
             except (BrokenPipeError, ConnectionResetError):
@@ -948,7 +1046,7 @@ class HttpFrontend:
             return sampling
 
         akw = {**self._adapter_kw(body), **self._tenant_kw(handler),
-               **self._trace_kw(handler)}
+               **self._trace_kw(handler), **self._deadline_kw(handler)}
         cands, submitted = [], []
         try:
             for p in prompts:
@@ -1072,7 +1170,7 @@ class HttpFrontend:
             request, q = self._submit_streaming(
                 prompt, max_new, sampling,
                 **self._adapter_kw(body), **self._tenant_kw(handler),
-                **self._trace_kw(handler))
+                **self._trace_kw(handler), **self._deadline_kw(handler))
             self._sse_head(handler,
                            self._trace_headers(handler, request))
             stream = _TextStream(self.tokenizer)
@@ -1090,13 +1188,18 @@ class HttpFrontend:
                             "choices": [{"index": 0,
                                          "delta": {"content": delta},
                                          "finish_reason": None}]})
-                tail = stream.flush()
-                delta = {"content": tail} if tail else {}
-                self._sse(handler, {
-                    **base, "object": "chat.completion.chunk",
-                    "choices": [{"index": 0, "delta": delta,
-                                 "finish_reason":
-                                     _finish(request.finish_reason)}]})
+                err = self._error_line(request)
+                if err is not None:
+                    self._sse(handler, {**base, **err})
+                else:
+                    tail = stream.flush()
+                    delta = {"content": tail} if tail else {}
+                    self._sse(handler, {
+                        **base, "object": "chat.completion.chunk",
+                        "choices": [{
+                            "index": 0, "delta": delta,
+                            "finish_reason":
+                                _finish(request.finish_reason)}]})
                 handler.wfile.write(b"data: [DONE]\n\n")
                 handler.wfile.flush()
             except (BrokenPipeError, ConnectionResetError):
@@ -1107,7 +1210,8 @@ class HttpFrontend:
                               sampling=sampling,
                               **self._adapter_kw(body),
                               **self._tenant_kw(handler),
-                              **self._trace_kw(handler))
+                              **self._trace_kw(handler),
+                              **self._deadline_kw(handler))
         toks = req.result()
         handler._json(200, {
             **base, "object": "chat.completion",

@@ -94,6 +94,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import math
 import os
 import threading
 import time
@@ -871,7 +872,7 @@ class PagedInferenceServer:
                  metrics: ServingMetrics | None = None,
                  flight_recorder_size: int | None = None,
                  qos=None, tracing=None, slo=None, spec_control=None,
-                 iteration_profile=None):
+                 iteration_profile=None, faults=None, brownout=None):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -1159,6 +1160,29 @@ class PagedInferenceServer:
         # QoS-enabled server too).
         from cloud_server_tpu.inference.qos import resolve_registry
         self.qos = resolve_registry(qos, infer_cfg.qos_config)
+        # failure-domain layer (inference/faults.py): deterministic
+        # fault injection + overload brownout. Both None unless
+        # configured — every guarded call site short-circuits, so the
+        # scheduler is byte-identical to the pre-fault build (the
+        # dispatch/device_get-count regression clones pin it, incl. a
+        # clone with a never-firing plan + brownout armed).
+        from cloud_server_tpu.inference.faults import (resolve_brownout,
+                                                       resolve_fault_plan)
+        self._faults = resolve_fault_plan(faults, infer_cfg.fault_plan)
+        self._brownout = resolve_brownout(brownout,
+                                          infer_cfg.brownout_config)
+        if self._brownout is not None and self.qos is None:
+            raise ValueError(
+                "brownout needs a QoS registry: shed sets are priority "
+                "classes, and without tenants nothing can be shed")
+        # _fail_all teardown accounting: how many times the bounded
+        # _step_lock acquire timed out and teardown proceeded
+        # UNSERIALIZED against a wedged scheduler (the
+        # cloud_server_unserialized_teardown_total counter; the
+        # timeout is an attribute so the wedged-step test does not
+        # wait out the production default)
+        self.unserialized_teardowns = 0
+        self._teardown_lock_timeout_s = 5.0
         self._draining = False
         # admission-latency bound: while prefill jobs are in flight,
         # decode dispatches shrink to this many rounds (default 1) so a
@@ -1219,9 +1243,20 @@ class PagedInferenceServer:
                sampling: SamplingParams | None = None,
                adapter: str | None = None,
                tenant: str | None = None,
-               trace_ctx: tuple | None = None) -> Request:
+               trace_ctx: tuple | None = None,
+               deadline_s: float | None = None,
+               fail_handler=None) -> Request:
         if self._stop.is_set():
             raise RuntimeError("server is stopped; not accepting requests")
+        if self._faults is not None:
+            self._faults.check("submit_reject")
+        if deadline_s is not None and not (
+                math.isfinite(deadline_s) and deadline_s > 0):
+            # `not (x > 0)` rather than `x <= 0`: NaN compares False
+            # BOTH ways and would otherwise slip through as a silent
+            # never-expiring deadline
+            raise ValueError("deadline_s must be a finite positive "
+                             "number of seconds")
         if (adapter is not None
                 and self.adapters.adapter_id(adapter) is None):
             raise ValueError(
@@ -1247,6 +1282,20 @@ class PagedInferenceServer:
             self._grammar_gid(sampling.regex)  # compile now; 400 here
         if self.qos is not None:
             tenant = self.qos.resolve(tenant)
+            if self._brownout is not None:
+                # overload brownout: shed this class's admissions with
+                # a jittered Retry-After (429) while the detector
+                # grades the replica overloaded — interactive traffic
+                # keeps its SLO instead of every class degrading
+                cls = self.qos.priority_class(tenant)
+                if self._brownout.shed(cls):
+                    from cloud_server_tpu.inference.faults import (
+                        BrownoutShedError)
+                    raise BrownoutShedError(
+                        f"overloaded: shedding {cls!r} admissions "
+                        "(brownout); retry later", tenant=tenant,
+                        priority_class=cls,
+                        retry_after_s=self._brownout.retry_hint())
         else:
             # no registry = no frozen tenant set to bound cardinality:
             # a caller-supplied string must not mint per-tenant labeled
@@ -1258,11 +1307,22 @@ class PagedInferenceServer:
                       seed_used=resolve_seed(sampling, self._host_rng,
                                              self._lock),
                       submit_time=time.perf_counter())
+        if deadline_s is None and self.qos is not None:
+            # per-QoS-class default deadline (None when the tenant's
+            # class declares none)
+            deadline_s = self.qos.default_deadline(tenant)
+        if deadline_s is not None:
+            req.deadline = req.submit_time + float(deadline_s)
         if self.slo is not None:
             # class mapping: the tenant's QoS priority class; plain
             # "default" without a registry
             req.slo_class = (self.qos.priority_class(tenant)
                              if self.qos is not None else None)
+        # the router's failover hook rides in THROUGH submit (not
+        # installed after it returns): once the request is in the
+        # pending queue any scheduler crash may complete it, and a
+        # hook landing late would miss its own failure
+        req._fail_handler = fail_handler
         req._on_cancel = self._handle_cancel  # before it can be seen
         with self._lock:
             # under the lock: drain() flips _draining under the same
@@ -1320,11 +1380,26 @@ class PagedInferenceServer:
         """Terminal bookkeeping for any request leaving the server:
         observe lifecycle metrics, then unblock waiters. Every path
         that ends a request (finish / cancel / fail) goes through here
-        so the telemetry can never miss a terminal state."""
+        so the telemetry can never miss a terminal state.
+
+        Failure interception: a request completing with an "error:"
+        reason is offered to its `_fail_handler` (installed by the
+        ReplicatedRouter at submit) AFTER the telemetry — the failure
+        really happened here — but BEFORE `_done`: a True return means
+        a failover retry on another replica now owns completion, so
+        waiters stay blocked until the retry finishes and mirrors its
+        outcome back."""
         self.metrics.observe_finish(req)
         if self.trace_recorder is not None and req.trace is not None:
             self.trace_recorder.finish(req)
+        h = req._fail_handler
+        if (h is not None and req.finish_reason is not None
+                and req.finish_reason.startswith("error") and h(req)):
+            return
         req._done.set()
+        cb = req._on_done
+        if cb is not None:
+            cb(req)
 
     def generate(self, prompts, *, max_new_tokens=None):
         reqs = [self.submit(p, max_new_tokens=max_new_tokens)
@@ -1543,6 +1618,13 @@ class PagedInferenceServer:
         preemption released into the cache and the sampled first token
         is simply the next token of the stream."""
         staged: list[int] = []
+        doomed: list[Request] = []  # impossible requests, completed
+        #                             AFTER the lock: _complete may run
+        #                             a router fail-handler that takes
+        #                             the ROUTER lock, and a router
+        #                             thread holding that lock reads
+        #                             num_pending (our _lock) — calling
+        #                             it here would be an ABBA deadlock
         with self._lock:
             free = [i for i, s in enumerate(self._slots) if s is None]
             while self._pending and free:
@@ -1567,6 +1649,17 @@ class PagedInferenceServer:
                 else:
                     total = len(prompt) + remaining + self.window
                 need = -(-total // self.page_size) - len(shared)
+                if (self._faults is not None
+                        and self._faults.fire("alloc_famine")
+                        is not None):
+                    # injected TRANSIENT page famine: release the walk
+                    # refs and retry next iteration — exercises the
+                    # famine-retry path without shrinking the pool or
+                    # permanently failing the request
+                    self.allocator.release(shared, prompt[:shared_len],
+                                           namespace=req.adapter or "",
+                                           tenant=req.tenant)
+                    break
                 fresh = self.allocator.alloc(max(0, need),
                                              tenant=req.tenant)
                 if fresh is None:
@@ -1575,14 +1668,18 @@ class PagedInferenceServer:
                                            tenant=req.tenant)
                     if self.num_active == 0 and not self._jobs:
                         # nothing running will ever free pages: the pool
-                        # is simply too small for this request
+                        # is simply too small for this request. Marked
+                        # REQUEST-caused: the router must not retry it
+                        # (it fails identically on every same-sized
+                        # replica) nor count it against the breaker
                         del self._pending[idx]
                         if self.qos is not None:
                             self.qos.on_pending_removed(req.tenant)
                         req.finish_reason = (
                             "error: request needs more pages than the "
                             "pool can ever provide")
-                        self._complete(req)
+                        req._request_fault = True
+                        doomed.append(req)
                         continue
                     break
                 del self._pending[idx]
@@ -1653,6 +1750,8 @@ class PagedInferenceServer:
                     # cache, so any staleness clears with it
                     self.spec_control.on_admit(slot_id)
                 staged.append(slot_id)
+        for req in doomed:
+            self._complete(req)
         if not staged:
             return
         now = time.perf_counter()  # one clock read per admission burst
@@ -1716,6 +1815,9 @@ class PagedInferenceServer:
             self._jobs.append(job)
 
     def _run_one_chunk(self, job: _AdmitJob) -> None:
+        if self._faults is not None:
+            # injected dispatch failure (see _mixed_dispatch)
+            self._faults.check("dispatch")
         c = job.next_chunk
         w = job.chunk_w
         g = len(job.slots)
@@ -1907,11 +2009,15 @@ class PagedInferenceServer:
                         and self.num_pending == 0
                         and self.allocator.available == 0
                         and self.num_active == 1):
-                    # genuinely impossible: alone with the whole pool
+                    # genuinely impossible: alone with the whole pool.
+                    # REQUEST-caused, like the admission-time twin —
+                    # the router must not retry it on an identically-
+                    # sized replica or charge the breaker for it
                     slot = self._release_slot(sid, self._committed(sid))
                     slot.req.finish_reason = (
                         "error: request needs more pages than the pool "
                         "can ever provide")
+                    slot.req._request_fault = True
                     self._complete(slot.req)
                     break
                 n_eff = min(n_eff, r_ok)
@@ -2028,6 +2134,9 @@ class PagedInferenceServer:
             st["spec_draft_lens"] = self.spec_control.draft_lengths()
 
     def _decode_dispatch(self) -> None:
+        if self._faults is not None:
+            # injected dispatch failure (see _mixed_dispatch)
+            self._faults.check("dispatch")
         prof = self._profiler
         n = self._chunk_rounds()
         if self.allocation == "ondemand":
@@ -2211,6 +2320,12 @@ class PagedInferenceServer:
         Admitting slots not selected this iteration ride along inert:
         width 0 and sentinel tables, so nothing they own can be
         written."""
+        if self._faults is not None:
+            # injected dispatch failure: raises before any device work,
+            # crashing this iteration the way a poisoned program would
+            # (serve_forever catches, _fail_all unblocks every waiter,
+            # the router's breaker/retry path takes it from there)
+            self._faults.check("dispatch")
         b = self.max_slots
         demand = sum(int(j.rem_lens[0]) - j.done for j in self._jobs)
         n_live = int(self.active.sum())
@@ -2448,17 +2563,52 @@ class PagedInferenceServer:
     # -- scheduler ----------------------------------------------------------
 
     def _sweep_cancelled(self) -> None:
-        """Reap cancelled requests that already hold a slot. Slots still
-        inside an admission job are left to finish their (bounded,
-        already-batched) chunks — _run_one_chunk checks the flag at
-        activation so they release without ever decoding."""
+        """Reap cancelled and deadline-expired requests that already
+        hold a slot (pages go back through the normal `_release_slot`
+        path — the KV they wrote is fully committed, so it stays
+        reusable in the prefix cache). Slots still inside an admission
+        job are left to finish their (bounded, already-batched)
+        chunks — _run_one_chunk checks the cancel flag at activation,
+        and an expired request is reaped by the next sweep. Expired
+        PENDING requests are reaped here too, so a deadline is honored
+        even if the request never reaches a slot. The expiry clock is
+        read lazily: zero reads per iteration when no live request
+        carries a deadline."""
         job_slots = {s for job in self._jobs for s in job.slots}
+        now = None
         for sid, slot in enumerate(self._slots):
-            if (slot is not None and slot.req._cancel.is_set()
-                    and sid not in job_slots):
+            if slot is None or sid in job_slots:
+                continue
+            if slot.req._cancel.is_set():
                 slot = self._release_slot(sid, self._committed(sid))
                 slot.req.finish_reason = "cancelled"
                 self._complete(slot.req)
+                continue
+            if slot.req.deadline is not None:
+                if now is None:
+                    now = time.perf_counter()
+                if now > slot.req.deadline:
+                    slot = self._release_slot(sid, self._committed(sid))
+                    slot.req.finish_reason = "deadline"
+                    self._complete(slot.req)
+        with self._lock:
+            expired = []
+            if any(r.deadline is not None for r in self._pending):
+                if now is None:
+                    now = time.perf_counter()
+                keep = collections.deque()
+                for r in self._pending:
+                    if r.deadline is not None and now > r.deadline:
+                        expired.append(r)
+                    else:
+                        keep.append(r)
+                self._pending = keep
+            for r in expired:
+                if self.qos is not None:
+                    self.qos.on_pending_removed(r.tenant)
+        for r in expired:
+            r.finish_reason = "deadline"
+            self._complete(r)
 
     def step(self) -> int:
         """One scheduler iteration: reap cancellations, start
@@ -2480,6 +2630,13 @@ class PagedInferenceServer:
             self.tracer.step_start()
             prof = self._profiler
             try:
+                if self._faults is not None:
+                    # injected host stall (the scheduler thread pays
+                    # it like a slow host/device round) and the wedge
+                    # site: block holding _step_lock until stop() —
+                    # the scenario _fail_all's bounded acquire covers
+                    self._faults.maybe_stall()
+                    self._faults.maybe_wedge(self._stop)
                 if prof is not None:
                     prof.begin()
                 al = self.allocator
@@ -2599,6 +2756,18 @@ class PagedInferenceServer:
         else:
             now = time.perf_counter()
             st["duration_ms"] = (now - t0) * 1e3
+        if self._brownout is not None:
+            # overload grading over signals this record already owns;
+            # the pending head's age is the queue-growth signal (one
+            # deque peek under the state lock)
+            with self._lock:
+                head = self._pending[0] if self._pending else None
+                age = (0.0 if head is None or head.submit_time is None
+                       else now - head.submit_time)
+            st["brownout_level"] = self._brownout.observe(
+                pending_age_s=age,
+                budget_utilization=st.get("budget_utilization", 0.0),
+                host_gap_frac=st.get("host_gap_frac", 0.0))
         st["ts"] = time.time()
         self.flight.record(**st)
         if spans:
@@ -2659,6 +2828,41 @@ class PagedInferenceServer:
                   "Rolling speculative accept rate (accepted/drafted "
                   "per committed round; lifetime ratio without the "
                   "adaptive controller)").set(rate)
+        # failure-domain observability (inference/faults.py): the
+        # families register unconditionally (zeros when nothing is
+        # configured) so the docs drift check — and dashboards — see
+        # them before the first incident, which is the whole point
+        reg.counter("unserialized_teardown_total",
+                    "_fail_all teardowns that proceeded after the "
+                    "bounded _step_lock acquire timed out (slot state "
+                    "torn down against a wedged scheduler)").set_total(
+                        self.unserialized_teardowns)
+        from cloud_server_tpu.inference.faults import SITES
+        from cloud_server_tpu.inference.qos import PRIORITY_CLASSES
+        fstats = (self._faults.stats() if self._faults is not None
+                  else None)
+        for site in SITES:
+            reg.counter("faults_injected_total",
+                        "Deliberately injected faults that fired, "
+                        "per site (inference/faults.py; zero without "
+                        "an armed FaultPlan)",
+                        labels={"site": site}).set_total(
+                            0 if fstats is None
+                            else fstats["fired"][site])
+        bstats = (self._brownout.stats() if self._brownout is not None
+                  else None)
+        reg.gauge("brownout_level",
+                  "Current overload brownout level (0 healthy, "
+                  "1 shedding best_effort, 2 shedding batch too)").set(
+                      0 if bstats is None else bstats["level"])
+        for cls in PRIORITY_CLASSES:
+            reg.counter("brownout_shed_total",
+                        "Admissions refused by overload brownout, per "
+                        "priority class (429 with jittered "
+                        "Retry-After)",
+                        labels={"class": cls}).set_total(
+                            0 if bstats is None
+                            else bstats["shed_total"].get(cls, 0))
         stats = self.allocator.stats()
         reg.gauge("pages_total",
                   "KV page pool size").set(stats.pages_total)
@@ -2828,6 +3032,17 @@ class PagedInferenceServer:
             "eviction_matrix": tel.eviction_matrix(),
         }
 
+    def brownout_stats(self) -> dict | None:
+        """The /stats `brownout` block (level, signal EWMAs vs
+        thresholds, per-class shed counts); None with brownout
+        disabled. Scrape path only."""
+        return None if self._brownout is None else self._brownout.stats()
+
+    def fault_stats(self) -> dict | None:
+        """Per-site injected-fault hit/fired counts (the /stats
+        `faults` block); None with no FaultPlan. Scrape path only."""
+        return None if self._faults is None else self._faults.stats()
+
     @property
     def ready(self) -> bool:
         """Readiness (vs the liveness /healthz always reported): False
@@ -2884,7 +3099,14 @@ class PagedInferenceServer:
         # it, so after the timeout teardown proceeds unserialized
         # (nothing else will ever release that lock). The crashed
         # serve_forever path acquires instantly — its step() exited.
-        got = self._step_lock.acquire(timeout=5.0)
+        got = self._step_lock.acquire(
+            timeout=self._teardown_lock_timeout_s)
+        if not got:
+            # make the unserialized teardown VISIBLE: before this
+            # counter, a timed-out acquire proceeded with no trace
+            # that slot state was torn down against a possibly-live
+            # dispatch (cloud_server_unserialized_teardown_total)
+            self.unserialized_teardowns += 1
         try:
             with self._lock:
                 pending, self._pending = (list(self._pending),

@@ -261,6 +261,32 @@ class TenantRegistry:
         self.quantum = float(config.get("quantum", 256))
         if self.quantum <= 0:
             raise ValueError("qos quantum must be > 0")
+        # per-priority-class default request deadlines (seconds): a
+        # number (every class) or {"interactive": 5, "batch": 60, ...}.
+        # Applied at submit when the caller passes no deadline_s; the
+        # scheduler sweep cancels expired requests (finish_reason
+        # "deadline") and the router stops failover retries past them.
+        dl = config.get("deadline_s")
+        if dl is None:
+            self._class_deadlines: dict[str, float] = {}
+        elif isinstance(dl, (int, float)):
+            self._class_deadlines = {c: float(dl)
+                                     for c in PRIORITY_CLASSES}
+        elif isinstance(dl, dict):
+            unknown_cls = set(dl) - set(PRIORITY_CLASSES)
+            if unknown_cls:
+                raise ValueError(
+                    f"deadline_s names unknown priority classes: "
+                    f"{sorted(unknown_cls)}")
+            self._class_deadlines = {c: float(v) for c, v in dl.items()}
+        else:
+            raise ValueError(
+                "deadline_s must be a number or a class->seconds map")
+        for c, v in self._class_deadlines.items():
+            if v <= 0:
+                raise ValueError(
+                    f"deadline_s for {c!r} must be > 0 (omit the class "
+                    "to leave it unbounded)")
         default = dict(config.get("default", {}))
         default.pop("api_keys", None)  # the fallback tenant has no keys
         self._states: dict[str, _TenantState] = {}
@@ -287,7 +313,8 @@ class TenantRegistry:
                         f"api key registered for both "
                         f"{self._api_keys[k]!r} and {name!r}")
                 self._api_keys[k] = name
-        unknown = set(config) - {"quantum", "default", "tenants"}
+        unknown = set(config) - {"quantum", "default", "tenants",
+                                 "deadline_s"}
         if unknown:
             raise ValueError(f"unknown qos config keys: {sorted(unknown)}")
 
@@ -332,6 +359,14 @@ class TenantRegistry:
 
     def weight(self, tenant: str | None) -> float:
         return self._state(self.resolve(tenant)).cfg.weight
+
+    def default_deadline(self, tenant: str | None) -> float | None:
+        """The tenant's class-default request deadline in seconds
+        (None = unbounded): submit() applies it when the caller passes
+        no explicit deadline_s. Plain dict reads on state frozen at
+        construction — submit-path hot."""
+        return self._class_deadlines.get(
+            self._state(self.resolve(tenant)).cfg.priority)
 
     def header_trusted(self, tenant: str) -> bool:
         """Whether a bare `X-Tenant: <tenant>` header claim is honored

@@ -35,7 +35,9 @@ Reference parity note: view-sonic/Cloud-Server @ v0 is an empty tree
 
 from __future__ import annotations
 
+import inspect
 import itertools
+import logging
 import threading
 import time
 import zlib
@@ -43,13 +45,75 @@ from typing import Sequence
 
 import jax
 
+from cloud_server_tpu.inference.server import QueueFullError
+
+_log = logging.getLogger(__name__)
+
+# Per-replica circuit-breaker states. closed = routing normally;
+# open = the replica failed `breaker_threshold` times in a row and is
+# excluded from placement until `breaker_reset_s` elapses; half_open =
+# the reset elapsed and exactly ONE probe submit may route there — its
+# outcome decides closed vs re-open.
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+_BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1,
+                  BREAKER_OPEN: 2}
+
+
+class _Breaker:
+    """One replica's circuit-breaker record (mutated under the
+    router's lock only)."""
+
+    __slots__ = ("state", "failures", "opened_at", "probing")
+
+    def __init__(self):
+        self.state = BREAKER_CLOSED
+        self.failures = 0       # consecutive, reset on any success
+        self.opened_at = 0.0    # monotonic moment the breaker opened
+        self.probing = False    # half_open: a probe submit is in flight
+
 
 class ReplicatedRouter:
-    """Route requests across independent serving replicas."""
+    """Route requests across independent serving replicas, with
+    per-replica circuit breakers and failover retry.
 
-    def __init__(self, replicas: Sequence):
+    Failure handling (the fleet's failure-domain contract):
+
+      * A replica whose submit() raises a server error is skipped and
+        the submit FAILS OVER to the next healthy replica; the client
+        never sees a single-replica crash as long as any replica
+        accepts.
+      * A request that fails IN FLIGHT (scheduler crash -> _fail_all,
+        stop-before-complete) is offered back to the router by the
+        replica's completion path (`Request._fail_handler`). If it
+        emitted ZERO tokens — the safe-retry rule: nothing was ever
+        streamed, so resubmission cannot duplicate output — and its
+        deadline has not passed, the router resubmits it to a healthy
+        replica (excluding every replica it already failed on) and the
+        original Request handle completes with the retry's outcome;
+        its trace gains a `router_retry` span in the same trace tree.
+        A partially-streamed request fails fast instead (the HTTP
+        front-end marks it `"retriable": false`).
+      * Every failure trips the failing replica's breaker: after
+        `breaker_threshold` consecutive failures it OPENS (excluded
+        from placement), after `breaker_reset_s` it half-opens for one
+        probe submit, and a probe success closes it again.
+
+    Breaker state is surfaced on /healthz (`breaker_states()`), and
+    the retry/failover/breaker counters ride `metrics_snapshot()` with
+    the `cloud_server_router_` families (docs/observability.md)."""
+
+    def __init__(self, replicas: Sequence, *,
+                 breaker_threshold: int = 3,
+                 breaker_reset_s: float = 30.0):
         if not replicas:
             raise ValueError("need at least one replica")
+        if breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
+        if breaker_reset_s <= 0:
+            raise ValueError("breaker_reset_s must be > 0")
         self.replicas = list(replicas)
         self._rr = itertools.count()
         self._lock = threading.Lock()
@@ -59,6 +123,55 @@ class ReplicatedRouter:
         # held across the replica's submit() — that can block on model
         # work — so the counter is what bridges the window)
         self._inflight = [0] * len(self.replicas)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_reset_s = float(breaker_reset_s)
+        self._breakers = [_Breaker() for _ in self.replicas]
+        # router-level metrics: the router owns fleet plumbing no
+        # replica can see (failovers, retries, breaker trips), so it
+        # keeps its own registry and merges it into metrics_snapshot()
+        from cloud_server_tpu.utils.serving_metrics import MetricsRegistry
+        reg = self._registry = MetricsRegistry()
+        self._m_failovers = reg.counter(
+            "router_submit_failovers_total",
+            "submit() calls re-routed after a replica refused with a "
+            "server error")
+        self._m_retries = reg.counter(
+            "router_retries_total",
+            "In-flight requests resubmitted to another replica after "
+            "failing with zero tokens emitted")
+        self._m_retry_success = reg.counter(
+            "router_retry_success_total",
+            "Failover retries whose resubmission completed normally")
+        self._m_breaker_open = reg.counter(
+            "router_breaker_open_total",
+            "Circuit-breaker open transitions (closed/half_open -> "
+            "open), fleet lifetime")
+        self._m_drainless = reg.counter(
+            "router_drainless_stops_total",
+            "stop(drain=...) calls that fell back to a drain-less "
+            "replica stop() (replica without drain support)")
+        for i in range(len(self.replicas)):
+            reg.gauge("router_breaker_state",
+                      "Per-replica breaker state (0 closed, 1 "
+                      "half_open, 2 open)",
+                      labels={"replica": str(i)})
+        reg.add_collector(self._collect_router_metrics)
+        # can each replica's submit() carry the failover hook?
+        # (our servers take `fail_handler=`; third-party backends
+        # without it — or without **kwargs — keep the old no-failover
+        # behavior instead of TypeError-ing every submit)
+        self._accepts_hook = [self._submit_takes_hook(r)
+                              for r in self.replicas]
+
+    @staticmethod
+    def _submit_takes_hook(replica) -> bool:
+        try:
+            params = inspect.signature(replica.submit).parameters
+        except (TypeError, ValueError):
+            return False
+        return ("fail_handler" in params
+                or any(p.kind == p.VAR_KEYWORD
+                       for p in params.values()))
 
     @classmethod
     def over_devices(cls, params, cfg, infer_cfg, *, devices=None,
@@ -78,8 +191,25 @@ class ReplicatedRouter:
 
     # -- placement ----------------------------------------------------------
 
+    def _breaker_admits_locked(self, i: int, now: float) -> bool:
+        """May placement route to replica `i` right now? (caller holds
+        the router lock). Lazily transitions open -> half_open when
+        the reset window elapsed; half_open admits only while no probe
+        is in flight."""
+        b = self._breakers[i]
+        if b.state == BREAKER_CLOSED:
+            return True
+        if b.state == BREAKER_OPEN:
+            if now - b.opened_at < self.breaker_reset_s:
+                return False
+            b.state = BREAKER_HALF_OPEN
+            b.probing = False
+        return not b.probing
+
     def _pick(self, *, tenant: str | None = None,
-              count_inflight: bool = False) -> int:
+              count_inflight: bool = False,
+              exclude: frozenset | set = frozenset(),
+              strict: bool = False) -> int | None:
         n = len(self.replicas)
         loads = [r.num_active + r.num_pending + inf
                  for r, inf in zip(self.replicas, self._inflight)]
@@ -92,26 +222,129 @@ class ReplicatedRouter:
             # prompts hit that replica's radix prefix cache) while
             # least-loaded still wins under any load skew
             k = zlib.crc32(tenant.encode()) % n
-        # readiness-aware placement: a draining (or stopped) replica
-        # advertises ready=False and stops receiving new work — its
-        # in-flight requests finish undisturbed. With the WHOLE fleet
-        # unready the pick falls back to all replicas so the submit
-        # surfaces the replica's own "draining" refusal instead of an
-        # index error.
-        cands = [j for j, r in enumerate(self.replicas)
-                 if getattr(r, "ready", True)] or list(range(n))
+        # readiness- and breaker-aware placement: a draining (or
+        # stopped) replica advertises ready=False, an open breaker
+        # excludes a repeatedly-failing one, and `exclude` carries a
+        # failover's already-failed set. Fallback chain (non-strict):
+        # healthy -> merely ready -> anything not excluded -> all, so
+        # a wholly-unready fleet surfaces the replica's own refusal
+        # instead of an index error. Strict mode (failover retries)
+        # returns None rather than re-picking an excluded replica —
+        # resubmitting to the replica that just failed the request
+        # would retry into the same failure.
+        now = time.monotonic()
+        ready = [j for j, r in enumerate(self.replicas)
+                 if j not in exclude and getattr(r, "ready", True)]
+        cands = ([j for j in ready
+                  if self._breaker_admits_locked(j, now)] or ready)
+        if not cands:
+            if strict:
+                return None
+            cands = ([j for j in range(n) if j not in exclude]
+                     or list(range(n)))
         # least loaded; ties resolve round-robin from k
         i = min(cands, key=lambda j: (loads[j], (j - k) % n))
+        b = self._breakers[i]
+        if b.state == BREAKER_HALF_OPEN and count_inflight:
+            # this pick is the probe (submit paths only — monitoring
+            # picks like embed() never resolve a probe, so they must
+            # not claim one)
+            b.probing = True
         if count_inflight:
             self._inflight[i] += 1
         return i
 
+    def _release_probe(self, i: int) -> None:
+        """A probe submit resolved WITHOUT a breaker verdict (client-
+        class refusal: queue full, bad request): free the half-open
+        slot so the next submit can probe — otherwise the breaker
+        wedges with `probing` latched forever."""
+        with self._lock:
+            b = self._breakers[i]
+            if b.state == BREAKER_HALF_OPEN:
+                b.probing = False
+
+    def _record_breaker_failure(self, i: int) -> None:
+        """One failure event on replica `i` (submit refusal or an
+        in-flight request failure): consecutive count up; at the
+        threshold — or on a failed half-open probe — the breaker
+        OPENS and placement stops routing there until the reset."""
+        with self._lock:
+            b = self._breakers[i]
+            b.failures += 1
+            if b.state == BREAKER_HALF_OPEN or (
+                    b.state == BREAKER_CLOSED
+                    and b.failures >= self.breaker_threshold):
+                b.state = BREAKER_OPEN
+                b.opened_at = time.monotonic()
+                b.probing = False
+                self._m_breaker_open.inc()
+
+    def _record_breaker_success(self, i: int) -> None:
+        with self._lock:
+            b = self._breakers[i]
+            b.failures = 0
+            b.state = BREAKER_CLOSED
+            b.probing = False
+
+    def _make_fail_hook(self, replica: int, prompt, kw: dict,
+                        excluded: frozenset, orig):
+        """The Request._fail_handler a submit carries INTO the
+        replica: context rides in the closure (no post-submit
+        attribute installation — a scheduler crash in that window
+        would otherwise complete the request past the hook). `orig`
+        is None on the first hop (the failing request IS the
+        original client handle)."""
+        def hook(req) -> bool:
+            return self._on_request_failed(
+                req, replica, prompt, kw, excluded,
+                orig if orig is not None else req)
+        return hook
+
     def submit(self, prompt, **kw):
         t0 = time.perf_counter()
-        with self._lock:
-            i = self._pick(tenant=kw.get("tenant"), count_inflight=True)
-        try:
-            req = self.replicas[i].submit(prompt, **kw)
+        excluded: set[int] = set()
+        while True:
+            with self._lock:
+                i = self._pick(tenant=kw.get("tenant"),
+                               count_inflight=True, exclude=excluded)
+            hkw = ({"fail_handler": self._make_fail_hook(
+                        i, prompt, dict(kw), frozenset(excluded),
+                        None)}
+                   if self._accepts_hook[i] else {})
+            try:
+                req = self.replicas[i].submit(prompt, **hkw, **kw)
+            except QueueFullError:
+                # backpressure (global bound, tenant 429, brownout
+                # shed): a CLIENT-class refusal, not a replica
+                # failure — no breaker event, no failover (the 429's
+                # Retry-After is the contract)
+                with self._lock:
+                    self._inflight[i] -= 1
+                self._release_probe(i)
+                raise
+            except RuntimeError as exc:
+                # server-class refusal (stopped, crashed, injected):
+                # trip the breaker — unless the replica is merely
+                # unready (draining), which is expected — and FAIL
+                # OVER to the next replica
+                with self._lock:
+                    self._inflight[i] -= 1
+                if getattr(self.replicas[i], "ready", True):
+                    self._record_breaker_failure(i)
+                else:
+                    self._release_probe(i)
+                excluded.add(i)
+                if len(excluded) >= len(self.replicas):
+                    raise
+                self._m_failovers.inc()
+                continue
+            except BaseException:
+                with self._lock:
+                    self._inflight[i] -= 1
+                self._release_probe(i)
+                raise
+            self._record_breaker_success(i)
             tr = getattr(req, "trace", None)
             if tr is not None:
                 # the fleet half of the request's ONE span tree: the
@@ -121,12 +354,174 @@ class ReplicatedRouter:
                 tr.annotate(replica=i)
                 tr.add_span("router_pick", t0, time.perf_counter(),
                             replica=i)
-            return req
-        finally:
-            # the request is now in the replica's pending queue (or was
-            # rejected) — either way its load is visible/settled again
+            # the request is now in the replica's pending queue — its
+            # load is visible/settled again (its failover hook rode
+            # IN through submit, so there is no install window a
+            # crash could slip past)
             with self._lock:
                 self._inflight[i] -= 1
+            return req
+
+    # -- failover retry ------------------------------------------------------
+
+    def _on_request_failed(self, req, replica: int, prompt, kw: dict,
+                           excluded: frozenset, orig) -> bool:
+        """Body of the closure _make_fail_hook plants as
+        Request._fail_handler: a router-submitted request completed
+        with an "error:" finish_reason on its replica. Runs on the
+        FAILING replica's thread (possibly inside _fail_all, holding
+        its step lock), so this only classifies and hands off; the
+        resubmission happens on a fresh daemon thread. True = the
+        router took ownership and a retry will complete the request;
+        False = the failure stands (the replica unblocks waiters)."""
+        if getattr(req, "_request_fault", False):
+            # REQUEST-caused error (e.g. it can never fit the page
+            # pool): it would fail identically on every replica — no
+            # retry, and no breaker event against a healthy replica
+            return False
+        self._record_breaker_failure(replica)
+        excluded = set(excluded) | {replica}
+        # the SAFE-RETRY rule: only a request that streamed NOTHING
+        # may be resubmitted (at-most-once token delivery); a
+        # partially-streamed request fails fast and the HTTP layer
+        # marks it retriable: false
+        if req.tokens or orig.tokens:
+            return False
+        if orig._cancel.is_set():
+            return False
+        if (orig.deadline is not None
+                and time.perf_counter() > orig.deadline):
+            return False  # past deadline: retrying cannot help
+        if len(excluded) >= len(self.replicas):
+            return False
+        with self._lock:
+            now = time.monotonic()
+            if not any(j not in excluded
+                       and getattr(r, "ready", True)
+                       and self._breaker_admits_locked(j, now)
+                       for j, r in enumerate(self.replicas)):
+                return False  # nowhere healthy to retry
+        self._m_retries.inc()
+        threading.Thread(
+            target=self._retry_submit,
+            args=(orig, replica, excluded, prompt, kw),
+            daemon=True, name="router-retry").start()
+        return True
+
+    def _retry_submit(self, orig, from_replica: int, excluded: set,
+                      prompt, kw) -> None:
+        """Resubmit a zero-token failed request to a healthy replica
+        (retry worker thread). The ORIGINAL Request stays the client's
+        handle: the retry submits with the same stream callback,
+        sampling, and tenant, joins the same trace (gaining a
+        `router_retry` span), and on completion mirrors its outcome
+        onto the original before unblocking its waiters."""
+        t_fail = time.perf_counter()
+        kw = dict(kw)
+        if orig.deadline is not None:
+            remaining = orig.deadline - time.perf_counter()
+            if remaining <= 0:
+                orig._done.set()  # expired while handing off
+                return
+            kw["deadline_s"] = remaining
+        tr0 = getattr(orig, "trace", None)
+        if tr0 is not None:
+            # the retry joins the ORIGINAL trace (same trace id,
+            # parented at the original root), so the hop is one story
+            kw["trace_ctx"] = (tr0.trace_id, tr0.root_span_id, True)
+        while True:
+            with self._lock:
+                i = self._pick(tenant=kw.get("tenant"),
+                               count_inflight=True, exclude=excluded,
+                               strict=True)
+            if i is None:
+                break  # nothing healthy left: the failure stands
+            hkw = ({"fail_handler": self._make_fail_hook(
+                        i, prompt, dict(kw), frozenset(excluded),
+                        orig)}
+                   if self._accepts_hook[i] else {})
+            try:
+                new = self.replicas[i].submit(prompt, **hkw, **kw)
+            except Exception as exc:  # noqa: BLE001 — any refusal: next
+                with self._lock:
+                    self._inflight[i] -= 1
+                if (isinstance(exc, RuntimeError)
+                        and not isinstance(exc, QueueFullError)
+                        and getattr(self.replicas[i], "ready", True)):
+                    self._record_breaker_failure(i)
+                else:
+                    self._release_probe(i)
+                excluded.add(i)
+                if len(excluded) >= len(self.replicas):
+                    break
+                continue
+            with self._lock:
+                self._inflight[i] -= 1
+            self._record_breaker_success(i)
+            if not hasattr(new, "_fail_handler"):
+                # a backend without the Request completion surface
+                # cannot report the retry's outcome back — the
+                # original failure stands (and the resubmitted work,
+                # if any, runs unobserved)
+                orig._done.set()
+                return
+            # error completions already route through the fail hook
+            # that rode IN with the submit; _on_done handles success
+            # mirroring. The only window left is a NORMAL completion
+            # before _on_done lands — closed by the idempotent
+            # re-check below.
+            new._router_orig = orig
+            new._on_done = self._mirror_retry
+            # cancel propagation: cancelling the original handle now
+            # cancels the retry (the original's own replica is gone).
+            # GENERATION-guarded under the router lock: a slow hop-N
+            # thread must not overwrite the link a later hop already
+            # installed — cancel() would then hit the dead earlier
+            # retry while the live one decodes on, orphaned. The
+            # excluded set grows strictly per hop, so its size is the
+            # hop's generation.
+            with self._lock:
+                gen = len(excluded)
+                if gen >= getattr(orig, "_router_cancel_gen", -1):
+                    orig._router_cancel_gen = gen
+                    orig._on_cancel = lambda _r, _n=new: _n.cancel()
+            if orig._cancel.is_set():
+                new.cancel()
+            tr = getattr(new, "trace", None)
+            if tr is not None:
+                tr.annotate(replica=i, retry_of=orig.request_id)
+                tr.add_span("router_retry", t_fail,
+                            time.perf_counter(),
+                            from_replica=from_replica, replica=i,
+                            attempt=len(excluded))
+            if new.done:
+                self._mirror_retry(new)
+            return
+        # could not resubmit anywhere: the original failure stands
+        orig._done.set()
+
+    def _mirror_retry(self, new) -> None:
+        """Request._on_done of a retry: copy the outcome onto the
+        original handle and unblock its waiters (tokens already
+        streamed through the shared stream callback). Idempotent
+        UNDER THE ROUTER LOCK — both the replica's _on_done callback
+        and the retry thread's done re-check may race here, and the
+        success counter must move exactly once."""
+        orig = getattr(new, "_router_orig", None)
+        if orig is None:
+            return
+        with self._lock:
+            if getattr(orig, "_router_mirrored", False):
+                return
+            orig._router_mirrored = True
+        orig.tokens = new.tokens
+        orig.logprobs = new.logprobs
+        orig.emit_times = new.emit_times
+        orig.finish_reason = new.finish_reason
+        if (new.finish_reason is not None
+                and not new.finish_reason.startswith("error")):
+            self._m_retry_success.inc()
+        orig._done.set()
 
     def generate(self, prompts, *, max_new_tokens=None):
         reqs = [self.submit(p, max_new_tokens=max_new_tokens)
@@ -179,6 +574,35 @@ class ReplicatedRouter:
         (a draining replica only removes itself from placement)."""
         return any(getattr(r, "ready", True) for r in self.replicas)
 
+    def breaker_states(self) -> list[dict]:
+        """Per-replica breaker view (the /healthz `replicas` block):
+        state, consecutive failures, and the replica's own readiness.
+        Reading surfaces any lazy open -> half_open transition, so
+        the report never shows an open breaker whose reset already
+        elapsed."""
+        with self._lock:
+            now = time.monotonic()
+            out = []
+            for i, b in enumerate(self._breakers):
+                self._breaker_admits_locked(i, now)
+                out.append({
+                    "replica": i, "state": b.state,
+                    "consecutive_failures": b.failures,
+                    "ready": bool(getattr(self.replicas[i], "ready",
+                                          True))})
+            return out
+
+    def _collect_router_metrics(self) -> None:
+        """Scrape-path mirror of breaker state into the router's own
+        registry (labeled per replica — a bounded set)."""
+        for st in self.breaker_states():
+            self._registry.gauge(
+                "router_breaker_state",
+                "Per-replica breaker state (0 closed, 1 half_open, "
+                "2 open)",
+                labels={"replica": str(st["replica"])}).set(
+                    _BREAKER_GAUGE[st["state"]])
+
     @property
     def tokens_emitted(self) -> int:
         return sum(r.tokens_emitted for r in self.replicas)
@@ -202,8 +626,12 @@ class ReplicatedRouter:
         be added across replicas by accident."""
         from cloud_server_tpu.utils.serving_metrics import merge_snapshots
         merged = merge_snapshots(
-            r.metrics_snapshot() for r in self.replicas
-            if hasattr(r, "metrics_snapshot"))
+            [r.metrics_snapshot() for r in self.replicas
+             if hasattr(r, "metrics_snapshot")]
+            # + the router's own families (failover/retry/breaker
+            # counters and per-replica breaker-state gauges): fleet
+            # plumbing no replica can observe
+            + [self._registry.snapshot()])
         tstats = self.tenant_stats()
         for key, entry in merged.items():
             if not key.startswith("cloud_server_tenant_fair_share{"):
@@ -391,8 +819,23 @@ class ReplicatedRouter:
 
     def step(self) -> int:
         busy = 0
-        for r in self.replicas:
-            busy += r.step()
+        for i, r in enumerate(self.replicas):
+            try:
+                busy += r.step()
+            except Exception as exc:  # noqa: BLE001 — replica crash
+                # a synchronously-driven replica whose scheduler throws
+                # gets the same teardown serve_forever would give it:
+                # stop accepting, fail its in-flight work (the failover
+                # hooks retry zero-token requests on healthy replicas),
+                # and trip its breaker — the other replicas keep
+                # stepping instead of the whole fleet dying with it
+                self._record_breaker_failure(i)
+                stop_ev = getattr(r, "_stop", None)
+                fail = getattr(r, "_fail_all", None)
+                if stop_ev is None or fail is None:
+                    raise
+                stop_ev.set()
+                fail(exc)
         return busy
 
     def run_until_idle(self) -> None:
@@ -408,8 +851,17 @@ class ReplicatedRouter:
 
     def stop(self, drain: bool = False,
              timeout: float | None = None) -> None:
-        for r in self.replicas:
+        for i, r in enumerate(self.replicas):
             try:
                 r.stop(drain=drain, timeout=timeout)
-            except TypeError:  # replica without drain support
+            except TypeError:
+                # replica without drain support: retry drain-less —
+                # but VISIBLY (counted + logged), because the drain
+                # the caller asked for did not happen on this replica
+                # and its in-flight work is about to be cut off
+                self._m_drainless.inc()
+                _log.warning(
+                    "replica %d stop() does not accept drain/timeout; "
+                    "stopping without drain (requested drain=%s "
+                    "timeout=%s)", i, drain, timeout)
                 r.stop()

@@ -38,6 +38,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import itertools
+import math
 import os
 import threading
 import time
@@ -477,6 +478,12 @@ class Request:
     # percentiles are where scheduling stalls show.
     submit_time: float | None = None
     emit_times: list[float] = dataclasses.field(default_factory=list)
+    # request deadline (absolute perf_counter moment, set at submit
+    # from deadline_s or the tenant's QoS-class default): the
+    # scheduler sweep cancels expired requests (finish_reason
+    # "deadline", pages released through the normal path) and the
+    # router stops failover retries past it. None = no deadline.
+    deadline: float | None = None
     # lifecycle telemetry: a stable id (access logs / timelines) plus an
     # event trail of (name, perf_counter time) pairs appended at host
     # moments the scheduler already owns — submit, every (re-)admission,
@@ -493,6 +500,22 @@ class Request:
     _cancel: threading.Event = dataclasses.field(
         default_factory=threading.Event)
     _on_cancel: Callable[["Request"], None] | None = None
+    # failure interception (ReplicatedRouter failover): when a request
+    # completes with an "error:" finish_reason, _complete offers it to
+    # this hook BEFORE unblocking waiters; a True return means the
+    # hook took ownership (a retry on another replica will complete
+    # the request), so _done stays unset. None (the default, and
+    # always for direct-server submits) keeps completion unchanged.
+    _fail_handler: Callable[["Request"], bool] | None = None
+    # completion callback invoked AFTER _done is set (the router's
+    # retry-mirroring path); None for everything else.
+    _on_done: Callable[["Request"], None] | None = None
+    # True when an "error:" completion was caused by the REQUEST
+    # itself (e.g. it can never fit the page pool) rather than the
+    # replica: the router must neither retry it elsewhere — it fails
+    # identically everywhere — nor count it against the replica's
+    # circuit breaker.
+    _request_fault: bool = False
 
     def cancel(self) -> None:
         """Abort this request. Pending requests finish immediately with
@@ -628,7 +651,7 @@ class InferenceServer:
                  prefix_remainder_cap: int = 1024,
                  metrics: ServingMetrics | None = None,
                  qos=None, tracing=None, slo=None,
-                 iteration_profile=None):
+                 iteration_profile=None, faults=None):
         # Serving never needs f32 master weights: pre-cast float32 leaves to
         # the compute dtype once, instead of streaming 2x the bytes and
         # converting on every decode step. QTensor leaves stay quantized
@@ -756,6 +779,14 @@ class InferenceServer:
         self.slo = resolve_slo(slo, infer_cfg.slo_config)
         if self.slo is not None:
             self.metrics.slo = self.slo
+        # deterministic fault injection (inference/faults.py): None
+        # unless configured — every guarded call site short-circuits,
+        # so the scheduler is byte-identical to the pre-fault build
+        # (the dispatch-count regression test pins it). The contiguous
+        # server arms submit_reject / dispatch / iteration_stall;
+        # wedge and alloc_famine are paged-scheduler shapes.
+        from cloud_server_tpu.inference.faults import resolve_fault_plan
+        self._faults = resolve_fault_plan(faults, infer_cfg.fault_plan)
         self._draining = False
         self._slots: list[Request | None] = [None] * max_slots
         self._pending: collections.deque[Request] = collections.deque()
@@ -778,12 +809,23 @@ class InferenceServer:
                stream: Callable[[int], None] | None = None,
                sampling: SamplingParams | None = None,
                tenant: str | None = None,
-               trace_ctx: tuple | None = None) -> Request:
+               trace_ctx: tuple | None = None,
+               deadline_s: float | None = None,
+               fail_handler=None) -> Request:
         if self._stop.is_set():
             # stop() was called or serve_forever died on a fatal error —
             # accepting now would enqueue work nothing will ever drain and
             # hang the caller's result() forever.
             raise RuntimeError("server is stopped; not accepting requests")
+        if self._faults is not None:
+            self._faults.check("submit_reject")
+        if deadline_s is not None and not (
+                math.isfinite(deadline_s) and deadline_s > 0):
+            # `not (x > 0)` rather than `x <= 0`: NaN compares False
+            # BOTH ways and would otherwise slip through as a silent
+            # never-expiring deadline
+            raise ValueError("deadline_s must be a finite positive "
+                             "number of seconds")
         if sampling is not None and sampling.regex is not None:
             raise ValueError(
                 "regex-constrained decoding is served by the paged "
@@ -810,11 +852,22 @@ class InferenceServer:
                       seed_used=resolve_seed(sampling, self._host_rng,
                                              self._lock),
                       submit_time=time.perf_counter())
+        if deadline_s is None and self.qos is not None:
+            # per-QoS-class default deadline (None when the tenant's
+            # class declares none)
+            deadline_s = self.qos.default_deadline(tenant)
+        if deadline_s is not None:
+            req.deadline = req.submit_time + float(deadline_s)
         if self.slo is not None:
             # class mapping: the tenant's QoS priority class; plain
             # "default" without a registry
             req.slo_class = (self.qos.priority_class(tenant)
                              if self.qos is not None else None)
+        # the router's failover hook rides in THROUGH submit (not
+        # installed after it returns): once the request is in the
+        # pending queue any scheduler crash may complete it, and a
+        # hook landing late would miss its own failure
+        req._fail_handler = fail_handler
         req._on_cancel = self._handle_cancel
         with self._lock:
             # under the lock: drain() flips _draining under the same
@@ -868,17 +921,62 @@ class InferenceServer:
         """Terminal bookkeeping for any request leaving the server:
         observe lifecycle metrics (finish reason, e2e latency), then
         unblock waiters. Every path that ends a request goes through
-        here so the telemetry can never miss a terminal state."""
+        here so the telemetry can never miss a terminal state.
+
+        Failure interception: a request completing with an "error:"
+        reason is offered to its `_fail_handler` (installed by the
+        ReplicatedRouter at submit) AFTER the telemetry — the failure
+        really happened here — but BEFORE `_done`: a True return means
+        a failover retry on another replica now owns completion, so
+        waiters stay blocked until the retry finishes and mirrors its
+        outcome back."""
         self.metrics.observe_finish(req)
         if self.trace_recorder is not None and req.trace is not None:
             self.trace_recorder.finish(req)
+        h = req._fail_handler
+        if (h is not None and req.finish_reason is not None
+                and req.finish_reason.startswith("error") and h(req)):
+            return
         req._done.set()
+        cb = req._on_done
+        if cb is not None:
+            cb(req)
 
     def _sweep_cancelled(self) -> None:
+        now = None
         for slot, req in enumerate(self._slots):
-            if req is not None and req._cancel.is_set():
+            if req is None:
+                continue
+            if req._cancel.is_set():
                 req.finish_reason = "cancelled"
                 self._finish(slot, req)
+                continue
+            if req.deadline is not None:
+                if now is None:  # lazily: zero reads with no deadlines
+                    now = time.perf_counter()
+                if now > req.deadline:
+                    req.finish_reason = "deadline"
+                    self._finish(slot, req)
+        # expired PENDING requests: reaped here too, so a deadline is
+        # honored even if the request never reaches a slot
+        with self._lock:
+            expired = []
+            if any(r.deadline is not None for r in self._pending):
+                if now is None:
+                    now = time.perf_counter()
+                keep = collections.deque()
+                for r in self._pending:
+                    if r.deadline is not None and now > r.deadline:
+                        expired.append(r)
+                    else:
+                        keep.append(r)
+                self._pending = keep
+            for r in expired:
+                if self.qos is not None:
+                    self.qos.on_pending_removed(r.tenant)
+        for r in expired:
+            r.finish_reason = "deadline"
+            self._complete(r)
 
     def generate(self, prompts: Sequence[Sequence[int]], *,
                  max_new_tokens: int | None = None) -> list[list[int]]:
@@ -1143,6 +1241,10 @@ class InferenceServer:
             self.tracer.step_start()
             prof = self._profiler
             try:
+                if self._faults is not None:
+                    # injected host stall: the scheduler thread pays
+                    # it exactly like a slow host/device round would
+                    self._faults.maybe_stall()
                 if prof is not None:
                     prof.begin()
                 self._iter_busy = False
@@ -1172,6 +1274,11 @@ class InferenceServer:
         if self.num_active == 0:
             return 0
         self._iter_busy = True
+        if self._faults is not None:
+            # injected dispatch failure: raises before any device work,
+            # crashing this iteration the way a poisoned program would
+            # (serve_forever catches, _fail_all unblocks every waiter)
+            self._faults.check("dispatch")
         n = self._chunk_len()
         use_rows, use_bias = self._rows_mode()
         if prof is not None:
@@ -1245,6 +1352,17 @@ class InferenceServer:
         reg.gauge("last_busy_ts",
                   "Unix time of the last busy iteration (0 until the "
                   "first)").set(self.last_busy_ts)
+        from cloud_server_tpu.inference.faults import SITES
+        fstats = (self._faults.stats() if self._faults is not None
+                  else None)
+        for site in SITES:
+            reg.counter("faults_injected_total",
+                        "Deliberately injected faults that fired, "
+                        "per site (inference/faults.py; zero without "
+                        "an armed FaultPlan)",
+                        labels={"site": site}).set_total(
+                            0 if fstats is None
+                            else fstats["fired"][site])
         reg.counter("prefix_hits_total",
                     "Admissions served from the cached prefix"
                     ).set_total(self.prefix_hits)
@@ -1294,6 +1412,11 @@ class InferenceServer:
         ReplicatedRouter merges these across replicas). None when no
         SLO config is set."""
         return None if self.slo is None else self.slo.report()
+
+    def fault_stats(self) -> dict | None:
+        """Per-site injected-fault hit/fired counts (the /stats
+        `faults` block); None with no FaultPlan. Scrape path only."""
+        return None if self._faults is None else self._faults.stats()
 
     def request_trace(self, n_steps: int,
                       logdir: str | os.PathLike) -> None:

@@ -384,6 +384,10 @@ class ServingMetrics:
         self.requeues = r.counter(
             "preempt_requeues_total",
             "Requests requeued after an on-demand-paging preemption")
+        self.deadline_expired = r.counter(
+            "deadline_expired_total",
+            "Requests cancelled by the scheduler sweep because their "
+            "deadline passed")
 
     def observe_submit(self, req) -> None:
         self.submitted.inc()
@@ -434,6 +438,8 @@ class ServingMetrics:
         req.record_event(f"finish:{reason}", now)
         if reason == "cancelled":
             self.cancelled.inc()
+        elif reason == "deadline":
+            self.deadline_expired.inc()
         elif reason.startswith("error"):
             self.failed.inc()
         else:

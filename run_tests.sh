@@ -34,7 +34,12 @@
 # [a-f], tests/test_cache_observability.py (KV-cache & memory
 # observability: per-tenant prefix attribution, eviction forensics,
 # the hot-prefix sketch + its fleet merge, /debug/cache) rides [a-f]
-# with test_block_allocator.py, and tests/test_iteration_profile.py
+# with test_block_allocator.py, tests/test_faults.py (failure-domain
+# layer: deterministic fault injection, request deadlines, overload
+# brownout, router breaker/failover e2e incl. the wedged-teardown
+# counter) rides [a-f] too, the router failover/breaker/drain-race
+# satellites ride tests/test_router.py in [p-r], and
+# tests/test_iteration_profile.py
 # (the scheduler phase
 # clock: overhead/clock-read guard, flight-record phase split,
 # /debug/scheduler_trace Perfetto export + span cross-links, idle

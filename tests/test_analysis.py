@@ -193,6 +193,54 @@ def test_checker_flags_bad_cache_paths():
                             ("BadCacheTelemetry.record_walk_fine",))
 
 
+def test_registry_covers_faults():
+    """The failure-domain layer's fire/check run per guarded site hit
+    on the scheduler iteration and submit paths, and the brownout
+    detector gates every submit — rostered like cache_telemetry.py on
+    all three passes (hot-path here; DD3 host-policy; lock-discipline
+    via LOCK_ROSTER)."""
+    from cloud_server_tpu.analysis import locks
+    quals = set(HOT_PATHS["cloud_server_tpu/inference/faults.py"])
+    for needed in ("FaultPlan.fire", "FaultPlan.check",
+                   "OverloadDetector.observe", "OverloadDetector.shed",
+                   "OverloadDetector.retry_hint"):
+        assert needed in quals, f"{needed} dropped from HOT_PATHS"
+    assert ("cloud_server_tpu/inference/faults.py"
+            in dispatch.HOST_POLICY_MODULES), \
+        "faults.py dropped from the DD3 host-policy roster"
+    assert ("cloud_server_tpu/inference/faults.py"
+            in locks.LOCK_ROSTER), \
+        "faults.py dropped from the lock-discipline roster"
+    # the per-submit deadline default lookup rode onto the qos roster
+    assert ("TenantRegistry.default_deadline"
+            in HOT_PATHS["cloud_server_tpu/inference/qos.py"])
+
+
+def test_checker_flags_bad_fault_paths():
+    """Fixture round-trip proving the checker is LIVE on the new
+    module's violation shapes: a sleep inside fire() (blocking belongs
+    only in the unrostered maybe_stall/maybe_wedge), wall-clock
+    overload stamps, numpy signal buffers, a blocking sync to grade
+    overload, logging/IO on the shed path — each must fire; the
+    dict-lookup shed shape the real detector uses must not."""
+    src = (_FIXTURES / "hot_path_faults_bad.py").read_text()
+    cases = {
+        "BadFaultPlan.fire_sleeps": "sleep",
+        "BadFaultPlan.fire_logged": "logging",
+        "BadFaultPlan.check_io": "I/O",
+        "BadOverloadDetector.observe_wall_clock": "time.time",
+        "BadOverloadDetector.observe_numpy": "numpy",
+        "BadOverloadDetector.level_synced": "sync",
+    }
+    for qual, needle in cases.items():
+        findings = check_source("hot_path_faults_bad.py", src, (qual,))
+        assert findings, f"{qual}: expected a finding"
+        assert any(needle in f.message for f in findings), \
+            f"{qual}: {[str(f) for f in findings]}"
+    assert not check_source("hot_path_faults_bad.py", src,
+                            ("BadOverloadDetector.shed_fine",))
+
+
 def test_checker_accepts_clean_fixture():
     src = (_FIXTURES / "hot_path_good.py").read_text()
     findings = check_source("hot_path_good.py", src,

@@ -288,10 +288,22 @@ _TRACING_SLO_KW = {
 
 _QOS_CACHE_KW = {"qos": {"tenants": {"a": {}, "b": {}}}}
 
+# failure-domain clone: a FaultPlan armed but never firing (after is
+# astronomically far) plus a live brownout detector — the THREADING
+# must add zero dispatches/syncs even when enabled. (The unconfigured
+# case — no FaultPlan at all — is the `plain` clone, unchanged.)
+_FAULTS_BROWNOUT_KW = {
+    "faults": {"seed": 0,
+               "faults": [{"site": "dispatch", "after": 10 ** 9}]},
+    "brownout": {"alpha": 0.3},
+    "qos": {"tenants": {"a": {}, "b": {}}}}
+
 
 @pytest.mark.parametrize("extra_kw",
-                         [{}, _TRACING_SLO_KW, _QOS_CACHE_KW],
-                         ids=["plain", "tracing_slo", "qos_cache"])
+                         [{}, _TRACING_SLO_KW, _QOS_CACHE_KW,
+                          _FAULTS_BROWNOUT_KW],
+                         ids=["plain", "tracing_slo", "qos_cache",
+                              "faults_brownout"])
 def test_mixed_step_dispatch_and_sync_count(params, monkeypatch,
                                             extra_kw):
     """The instrumented mixed-scheduler iteration still issues exactly
@@ -554,9 +566,13 @@ def test_metric_catalog_matches_docs(params):
     paged = PagedInferenceServer(params, CFG, GREEDY,
                                  qos={"tenants": {"a": {}}},
                                  slo=_TRACING_SLO_KW["slo"], **PAGED_KW)
+    # behind a router so the cloud_server_router_* families (failover/
+    # retry/breaker counters + breaker-state gauges) register too
+    from cloud_server_tpu.inference.router import ReplicatedRouter
+    router = ReplicatedRouter([paged])
     runtime = {name.split("{")[0] for name in
                set(contig.metrics_snapshot())
-               | set(paged.metrics_snapshot())}
+               | set(router.metrics_snapshot())}
     missing_from_docs = runtime - catalog
     stale_in_docs = catalog - runtime
     assert not missing_from_docs, (

@@ -142,6 +142,222 @@ def test_router_skips_draining_replica(params):
         rep.resume()
 
 
+def test_drainless_stop_counted_and_logged(caplog):
+    """ReplicatedRouter.stop()'s TypeError fallback (a replica whose
+    stop() takes no drain/timeout) must be visible: counted in
+    cloud_server_router_drainless_stops_total and logged — before this
+    it silently retried without drain."""
+    import logging
+
+    class _NoDrainStub:
+        def __init__(self):
+            self.stopped = False
+            self.num_active = 0
+            self.num_pending = 0
+
+        def submit(self, prompt, **kw):
+            return prompt
+
+        def stop(self):  # no drain/timeout kwargs
+            self.stopped = True
+
+    stub = _NoDrainStub()
+    r = ReplicatedRouter([stub])
+    with caplog.at_level(logging.WARNING,
+                         logger="cloud_server_tpu.inference.router"):
+        r.stop(drain=True, timeout=0.1)
+    assert stub.stopped
+    assert any("without drain" in rec.message for rec in caplog.records)
+    snap = r.metrics_snapshot()
+    assert snap["cloud_server_router_drainless_stops_total"][
+        "value"] == 1
+
+
+def test_breaker_open_half_open_close_cycle():
+    """Per-replica circuit breaker: consecutive submit failures OPEN
+    the breaker (placement stops routing there), the reset window
+    half-opens it for one probe submit, a failed probe re-opens, and
+    a successful probe closes it."""
+    import time as _time
+
+    class _FlakyStub:
+        def __init__(self, preload=0):
+            self.fail = False
+            self.got = []
+            self.num_active = 0
+            self._preload = preload
+
+        @property
+        def num_pending(self):
+            return self._preload  # static: placement stays stable
+
+        def submit(self, prompt, **kw):
+            if self.fail:
+                raise RuntimeError("replica exploded")
+            self.got.append(prompt)
+            return prompt
+
+    flaky, good = _FlakyStub(), _FlakyStub(preload=1)
+    r = ReplicatedRouter([flaky, good], breaker_threshold=2,
+                         breaker_reset_s=0.1)
+    flaky.fail = True
+    # two failing submits: each picks flaky (least loaded), trips a
+    # failure, and FAILS OVER to good — the client never sees them
+    for k in range(2):
+        assert r.submit([k]) == [k]
+    assert [g for g in good.got] == [[0], [1]]
+    states = r.breaker_states()
+    assert states[0]["state"] == "open"
+    assert states[0]["consecutive_failures"] == 2
+    snap = r.metrics_snapshot()
+    assert snap["cloud_server_router_submit_failovers_total"][
+        "value"] == 2
+    assert snap["cloud_server_router_breaker_open_total"]["value"] == 1
+    # while open: placement avoids flaky entirely (no new failures)
+    r.submit([2])
+    assert good.got[-1] == [2]
+    assert r.breaker_states()[0]["consecutive_failures"] == 2
+    # reset elapses -> half_open -> the probe submit fails -> re-open
+    _time.sleep(0.12)
+    assert r.breaker_states()[0]["state"] == "half_open"
+    r.submit([3])  # probe fails over to good, breaker re-opens
+    assert good.got[-1] == [3]
+    assert r.breaker_states()[0]["state"] == "open"
+    # reset again, replica recovered -> probe succeeds -> closed
+    _time.sleep(0.12)
+    flaky.fail = False
+    r.submit([4])
+    assert flaky.got == [[4]]
+    assert r.breaker_states()[0]["state"] == "closed"
+    assert r.breaker_states()[0]["consecutive_failures"] == 0
+
+
+def test_half_open_probe_released_on_client_refusal():
+    """A probe submit that resolves with a CLIENT-class refusal
+    (QueueFullError) is neither a breaker success nor a failure — but
+    it must release the half-open probe slot, or the breaker wedges
+    with `probing` latched and the replica never rejoins."""
+    import time as _time
+
+    from cloud_server_tpu.inference.server import QueueFullError
+
+    class _Stub:
+        def __init__(self, preload=0):
+            self.mode = "ok"
+            self.got = []
+            self.num_active = 0
+            self._preload = preload
+
+        @property
+        def num_pending(self):
+            return self._preload
+
+        def submit(self, prompt, **kw):
+            if self.mode == "boom":
+                raise RuntimeError("boom")
+            if self.mode == "full":
+                raise QueueFullError("queue full")
+            self.got.append(prompt)
+            return prompt
+
+    flaky, good = _Stub(), _Stub(preload=1)
+    r = ReplicatedRouter([flaky, good], breaker_threshold=1,
+                         breaker_reset_s=0.05)
+    flaky.mode = "boom"
+    r.submit([0])  # fails over; breaker opens at threshold 1
+    assert r.breaker_states()[0]["state"] == "open"
+    _time.sleep(0.06)
+    flaky.mode = "full"  # the probe gets a 429, not a failure
+    with pytest.raises(QueueFullError):
+        r.submit([1])
+    st = r.breaker_states()[0]
+    assert st["state"] == "half_open"
+    # the probe slot was released: the next submit probes again and
+    # the recovered replica closes its breaker
+    flaky.mode = "ok"
+    r.submit([2])
+    assert flaky.got == [[2]]
+    assert r.breaker_states()[0]["state"] == "closed"
+
+
+def test_drain_resume_racing_concurrent_submits():
+    """drain()/resume() toggling on one replica while submitter
+    threads hammer the router: the ready-flag race (picked while
+    ready, draining by the time submit lands) is absorbed by submit
+    failover, so no client ever sees a refusal and every request
+    lands on exactly one replica."""
+    import threading
+    import time as _time
+
+    class _DrainStub:
+        def __init__(self):
+            self._draining = False
+            self.got = []
+            self._lock = threading.Lock()
+            self.num_active = 0
+
+        @property
+        def ready(self):
+            return not self._draining
+
+        @property
+        def num_pending(self):
+            return 0  # static load: the toggle is the only variable
+
+        def submit(self, prompt, **kw):
+            with self._lock:
+                if self._draining:
+                    raise RuntimeError(
+                        "server is draining; not accepting requests")
+                self.got.append(prompt)
+            return prompt
+
+        def drain(self):
+            with self._lock:
+                self._draining = True
+            return True
+
+        def resume(self):
+            with self._lock:
+                self._draining = False
+
+    r0, r1 = _DrainStub(), _DrainStub()
+    router = ReplicatedRouter([r0, r1])
+    errors = []
+    done = threading.Event()
+
+    def toggler():
+        while not done.is_set():
+            r0.drain()
+            _time.sleep(0.0005)
+            r0.resume()
+            _time.sleep(0.0005)
+
+    def submitter(base):
+        try:
+            for k in range(50):
+                router.submit([base + k])
+        except Exception as exc:  # noqa: BLE001 — the assertion
+            errors.append(exc)
+
+    tog = threading.Thread(target=toggler, daemon=True)
+    tog.start()
+    subs = [threading.Thread(target=submitter, args=(1000 * i,))
+            for i in range(4)]
+    for t in subs:
+        t.start()
+    for t in subs:
+        t.join(30)
+    done.set()
+    tog.join(5)
+    assert not errors, f"submits failed through the race: {errors!r}"
+    landed = r0.got + r1.got
+    assert len(landed) == 200
+    assert len({tuple(p) for p in landed}) == 200  # exactly-once
+    # the drain window really diverted traffic (r1 saw the overflow)
+    assert r1.got
+
+
 def test_burst_submit_sees_inflight_picks():
     """ADVICE r5: a submit still blocked inside its replica (the router
     lock is not held across replica.submit) must be visible to
