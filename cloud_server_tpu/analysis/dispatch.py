@@ -31,12 +31,25 @@ Rules:
     value compiles a new program variant (the compile-variant
     invariant PR 9's ``{0, spec_drafts}`` draft-width quantization
     depends on). Bounded means: constants, ``self.*`` configuration,
-    boolean expressions, callee parameters declared ``bool``, and
-    the audited bucketing helpers in ``BOUNDED_HELPERS``
-    (power-of-two rounding / bucket tables / round planners) —
-    composed through arithmetic, min/max, and conditionals. A raw
-    ``len(...)``, a request field, or any other data-dependent value
-    flags.
+    boolean expressions, callee parameters declared ``bool``, the
+    audited bucketing helpers in ``BOUNDED_HELPERS`` (power-of-two
+    rounding / bucket tables / round planners) — composed through
+    arithmetic, min/max, and conditionals — and the reviewed
+    ``plan.*`` fields in ``PLAN_BOUNDED_FIELDS`` (the async
+    scheduler's ``_launch_plan`` replays statics the planner already
+    computed through those same bounded helpers). A raw ``len(...)``,
+    a request field, or any other data-dependent value flags.
+  * ``DD5 overlap write-safety`` — the async double-buffered
+    scheduler plans iteration N+1 WHILE iteration N's dispatch is in
+    flight. A page released during that window can be re-allocated to
+    a new admission while the device still writes it, so the
+    functions in ``OVERLAP_PLAN_FUNCS`` (the plan/launch path and the
+    deferred sweep) must never reach — directly or transitively
+    through same-class helpers — any of the page-releasing /
+    slot-teardown functions in ``PAGE_RELEASING_FUNCS``. Releases
+    belong to the commit (``_commit_inflight`` / ``_apply_reaps``)
+    and to the sequential paths, which only run with nothing in
+    flight.
 
 Stdlib-only (ast); never imports jax or the serving stack.
 """
@@ -62,6 +75,16 @@ SCHEDULER_LOOPS: dict[str, tuple[str, ...]] = {
     "cloud_server_tpu/inference/paged_server.py": (
         "PagedInferenceServer.step",
         "PagedInferenceServer.serve_forever",
+        "PagedInferenceServer._step_overlap",
+        "PagedInferenceServer._plan_iteration",
+        "PagedInferenceServer._launch_plan",
+        "PagedInferenceServer._commit_inflight",
+        "PagedInferenceServer._overlap_sweep",
+        "PagedInferenceServer._apply_reaps",
+        "PagedInferenceServer._extend_chains_planned",
+        "PagedInferenceServer._build_prefill_group",
+        "PagedInferenceServer._select_prefill",
+        "PagedInferenceServer._expire_pending",
         "PagedInferenceServer._sweep_cancelled",
         "PagedInferenceServer._start_admissions",
         "PagedInferenceServer._run_one_chunk",
@@ -90,6 +113,9 @@ SCHEDULER_LOOPS: dict[str, tuple[str, ...]] = {
     "cloud_server_tpu/inference/server.py": (
         "InferenceServer.step",
         "InferenceServer._step_locked",
+        "InferenceServer._step_locked_overlap",
+        "InferenceServer._commit_decode_chunk",
+        "InferenceServer._launch_decode",
         "InferenceServer.serve_forever",
         "InferenceServer._sweep_cancelled",
         "InferenceServer._admit_pending",
@@ -116,12 +142,48 @@ SANCTIONED_SYNCS: dict[str, tuple[str, ...]] = {
         "PagedInferenceServer._run_one_chunk",
         "PagedInferenceServer._decode_dispatch",
         "PagedInferenceServer._mixed_dispatch",
+        # async scheduler: the launch-ahead dispatch's commit point —
+        # still ONE device_get per committed iteration; _launch_plan
+        # itself must stay sync-free (DD2 covers it like every other
+        # loop function)
+        "PagedInferenceServer._commit_inflight",
     ),
     "cloud_server_tpu/inference/server.py": (
         "InferenceServer._admit_group",
         "InferenceServer._step_locked",
+        "InferenceServer._commit_decode_chunk",
     ),
 }
+
+# DD5: the async scheduler's plan/launch path — everything that runs
+# while a dispatch may be in flight — and the page-releasing functions
+# it must never reach. Transitive through same-class helper calls.
+OVERLAP_PLAN_FUNCS: dict[str, tuple[str, ...]] = {
+    "cloud_server_tpu/inference/paged_server.py": (
+        "PagedInferenceServer._plan_iteration",
+        "PagedInferenceServer._extend_chains_planned",
+        "PagedInferenceServer._overlap_sweep",
+        "PagedInferenceServer._launch_plan",
+        "PagedInferenceServer._build_prefill_group",
+        "PagedInferenceServer._select_prefill",
+    ),
+}
+PAGE_RELEASING_FUNCS = frozenset({
+    "_release_slot", "_preempt_youngest", "_finish", "_extend_chains",
+    "_fail_all", "_sweep_cancelled",
+    # allocator page release (self.allocator.release / the lock-free
+    # variants); plan-path code may alloc, never release
+    "release",
+})
+
+# DD4: reviewed fields of the async scheduler's _Plan snapshot that
+# are bounded BY CONSTRUCTION — _plan_iteration computes them through
+# the same audited helpers this pass already trusts (n_rounds via the
+# _mixed_rounds/_chunk_rounds pow2 planners, g_iter via _spec_plan's
+# {0, spec_drafts} quantization) — so _launch_plan replaying them into
+# the jits' static arguments cannot mint new compile variants. Adding
+# a field here is a reviewed decision, exactly like BOUNDED_HELPERS.
+PLAN_BOUNDED_FIELDS = frozenset({"n_rounds", "g_iter"})
 
 # Pure host-side policy modules: scheduling decisions, accounting,
 # telemetry. The servers are the only modules allowed to touch jax.
@@ -345,6 +407,10 @@ class _Boundedness:
         if isinstance(node, ast.Name):
             return node.id not in self.unbounded
         if isinstance(node, ast.Attribute):
+            if (isinstance(node.value, ast.Name)
+                    and node.value.id == "plan"
+                    and node.attr in PLAN_BOUNDED_FIELDS):
+                return True  # reviewed _Plan statics (see the constant)
             return _self_rooted(node)  # init-time configuration
         if isinstance(node, (ast.Compare, ast.BoolOp)):
             return True  # boolean-valued: at most two variants
@@ -470,6 +536,57 @@ def check_scheduler_source(path: str, source: str,
     return out
 
 
+def check_overlap_source(path: str, source: str,
+                         plan_quals: tuple[str, ...]) -> list[Finding]:
+    """DD5 over one server module: no page-releasing function is
+    reachable from the overlap plan path — directly, or transitively
+    through same-class ``self.*`` helper calls."""
+    tree = ast.parse(source, filename=path)
+    found, classes = collect_functions(tree)
+    out: list[Finding] = []
+
+    def self_calls(fn: ast.AST):
+        """(leaf name, node) for every self.X(...) / X(...) call."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name is None:
+                continue
+            yield name.rsplit(".", 1)[-1], name, node
+
+    for qual in plan_quals:
+        fn = found.get(qual)
+        if fn is None:
+            out.append(Finding(
+                path, enclosing_class_line(classes, qual), CHECKER,
+                qual, "overlap-plan function not found (renamed? "
+                      "update OVERLAP_PLAN_FUNCS)"))
+            continue
+        cls = qual.rsplit(".", 1)[0]
+        seen: set[str] = set()
+        stack: list[tuple[str, ast.AST]] = [(qual, fn)]
+        while stack:
+            cur_qual, cur_fn = stack.pop()
+            if cur_qual in seen:
+                continue
+            seen.add(cur_qual)
+            for leaf, name, node in self_calls(cur_fn):
+                if leaf in PAGE_RELEASING_FUNCS:
+                    out.append(Finding(
+                        path, node.lineno, CHECKER, qual,
+                        f"overlap-plan path reaches page-releasing "
+                        f"{name}() (via {cur_qual}) while a dispatch "
+                        "may be in flight — releases belong to the "
+                        "commit (DD5)"))
+                    continue
+                callee_qual = f"{cls}.{leaf}"
+                callee = found.get(callee_qual)
+                if callee is not None and name.startswith("self."):
+                    stack.append((callee_qual, callee))
+    return out
+
+
 def check_host_policy_source(path: str, source: str) -> list[Finding]:
     """DD3: no jax/jnp/lax anywhere in a host-policy module."""
     tree = ast.parse(source, filename=path)
@@ -506,6 +623,9 @@ def check_dispatch(root: str | None = None) -> list[Finding]:
             continue
         out.extend(check_scheduler_source(
             rel, source, quals, SANCTIONED_SYNCS.get(rel, ())))
+        plan_quals = OVERLAP_PLAN_FUNCS.get(rel)
+        if plan_quals:
+            out.extend(check_overlap_source(rel, source, plan_quals))
     for rel in HOST_POLICY_MODULES:
         source, missing = read_rostered(root, rel, CHECKER)
         if missing is not None:
@@ -518,8 +638,8 @@ def check_dispatch(root: str | None = None) -> list[Finding]:
 register_pass(Pass(
     id=CHECKER,
     title="one sanctioned device_get per scheduler iteration, jax-free "
-          "host-policy modules, and statically bounded jit static "
-          "arguments",
+          "host-policy modules, statically bounded jit static "
+          "arguments, and a release-free overlap plan path",
     run=check_dispatch,
     roster=lambda root: tuple(SCHEDULER_LOOPS) + HOST_POLICY_MODULES,
 ))

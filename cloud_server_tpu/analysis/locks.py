@@ -161,6 +161,25 @@ class _ClassAnalysis:
                         attr = _self_attr(tgt)
                         if attr is not None:
                             self.locks.add(attr)
+        # condition variables constructed OVER a class lock alias it:
+        # `self._work = threading.Condition(self._lock)` means `with
+        # self._work:` holds _lock (that IS the Condition's mutex), so
+        # guarded-attribute checks must credit it
+        self.lock_aliases: dict[str, str] = {}
+        for fn in self.methods.values():
+            for n in ast.walk(fn):
+                if (isinstance(n, ast.Assign)
+                        and isinstance(n.value, ast.Call)):
+                    name = _dotted(n.value.func)
+                    if (name is not None
+                            and name.split(".")[-1] == "Condition"
+                            and n.value.args):
+                        src = _self_attr(n.value.args[0])
+                        if src in self.locks:
+                            for tgt in n.targets:
+                                attr = _self_attr(tgt)
+                                if attr is not None:
+                                    self.lock_aliases[attr] = src
         self.scans: dict[str, _MethodScan] = {}
 
     # -- lexical scan -------------------------------------------------------
@@ -201,6 +220,7 @@ class _ClassAnalysis:
             acquired = set()
             for item in node.items:
                 attr = _self_attr(item.context_expr)
+                attr = self.lock_aliases.get(attr, attr)
                 if attr in self.locks:
                     # items acquire LEFT TO RIGHT: each sees the locks
                     # the earlier items already took, so a one-liner
@@ -248,7 +268,7 @@ class _ClassAnalysis:
     def _record_attr(self, node: ast.Attribute, attr: str,
                      held: frozenset, ms: _MethodScan,
                      write: bool | None = None) -> None:
-        if attr in self.locks:
+        if attr in self.locks or attr in self.lock_aliases:
             return
         if attr in self.properties:
             # a property read runs the getter: a call-graph edge

@@ -190,6 +190,21 @@ class InferConfig:
     #   "alternating" — separate prefill-chunk and decode dispatches
     #     per scheduler step (the pre-mixed behavior; the fallback).
     scheduler: str = "mixed"
+    # Async double-buffered scheduling (paged server, MIXED scheduler
+    # only — the alternating scheduler always keeps its sequential
+    # per-chunk loop; the contiguous server's simpler launch-ahead
+    # decode pipelining is gated on this same knob). True (the
+    # default) overlaps host policy work —
+    # sweep, QoS/DRR admission, deadline checks, and the numpy
+    # dispatch build — with the device executing the PREVIOUS
+    # iteration's fused program: each step plans iteration N+1 against
+    # the last committed ledger while iteration N runs, then pays only
+    # the sanctioned device_get commit (+ a cheap ledger patch and the
+    # next launch) on the serialized critical path. False restores the
+    # byte-identical sequential loop (plan -> dispatch -> sync ->
+    # commit per step, nothing in flight across steps). Constructor
+    # argument `overlap=` / the CLI's `--no-overlap` override.
+    overlap: bool = True
     # Tokens per mixed iteration: all live decode rows (times their
     # round count) plus however many prefill-chunk tokens fit. 0 = auto:
     # max_slots * (decode window * decode_chunk + prefill_chunk) —

@@ -111,6 +111,17 @@ def build_parser() -> argparse.ArgumentParser:
                    "(stall-free — decodes advance during every prefill); "
                    "'alternating' keeps separate prefill and decode "
                    "dispatches (the pre-mixed behavior)")
+    p.add_argument("--no-overlap", action="store_true",
+                   help="disable the async double-buffered scheduler "
+                   "(launch-ahead pipelining): by default each "
+                   "iteration's host policy work — sweep, QoS/DRR "
+                   "admission, deadline checks, the numpy dispatch "
+                   "build — runs WHILE the device executes the "
+                   "previous iteration's program, leaving only the "
+                   "commit on the serialized path. This flag restores "
+                   "the strictly sequential plan->dispatch->sync->"
+                   "commit loop (byte-identical pre-overlap behavior; "
+                   "outputs are token-identical either way)")
     p.add_argument("--mixed-token-budget", type=int, default=0,
                    help="mixed scheduler: tokens per fused iteration "
                    "(decode rows first, prefill fills the rest; 0 = auto: "
@@ -395,6 +406,7 @@ def main(argv=None) -> None:
                 slo=args.slo_config,
                 tracing=args.trace_sample_rate or None,
                 faults=args.fault_plan,
+                overlap=False if args.no_overlap else None,
                 iteration_profile=False if args.no_iteration_profile else None)
         if args.prefix:
             print("[generate] note: the paged server reuses shared "
@@ -421,6 +433,7 @@ def main(argv=None) -> None:
             prefill_chunk=prefill_chunk, seed=args.seed,
             allocation=args.allocation,
             scheduler=args.scheduler,
+            overlap=False if args.no_overlap else None,
             mixed_token_budget=args.mixed_token_budget,
             flight_recorder_size=args.flight_recorder or None,
             draft_params=draft_params, draft_cfg=draft_cfg,

@@ -73,20 +73,25 @@ Endpoints:
                     (?n=K bounds the window, default 64) and — with
                     the iteration profiler on (the default) — an
                     `iteration_profile` summary (per-phase
-                    count/mean/p50/p99 ms + host_gap_frac). Paged
-                    backends add a `cache` block (the /debug/cache
-                    payload).
+                    count/mean/p50/p99 ms + host_gap_frac) and an
+                    `overlap` block (the async double-buffered
+                    scheduler's resolved knob state + live pipeline
+                    depth). Paged backends add a `cache` block (the
+                    /debug/cache payload).
   GET  /debug/scheduler_trace  Chrome-trace/Perfetto export of the
                     flight recorder's recent window (?n=K, default
                     64): one track per scheduler phase (sweep /
-                    admission / build / device / commit / epilogue)
-                    plus an iteration track carrying each record's
-                    scalars. Same perf_counter timebase as /traces,
-                    and every event tags its flight-recorder
-                    iteration index — the two-way cross-link between
-                    "this request's decode_segment was slow" and
-                    "what the scheduler was doing that iteration"
-                    (inference/iteration_profile.py).
+                    admission / build / device / commit / launch /
+                    epilogue) plus an iteration track carrying each
+                    record's scalars, and an `inflight` track whose
+                    slices render the async scheduler's
+                    launched-ahead dispatches CONCURRENT with the
+                    iteration that commits them. Same perf_counter
+                    timebase as /traces, and every event tags its
+                    flight-recorder iteration index — the two-way
+                    cross-link between "this request's decode_segment
+                    was slow" and "what the scheduler was doing that
+                    iteration" (inference/iteration_profile.py).
   GET  /debug/cache KV-cache & memory observability
                     (inference/cache_telemetry.py): pool occupancy
                     split free/cached/active with the evictable
@@ -658,6 +663,13 @@ class HttpFrontend:
         cfn = getattr(self.srv, "cache_stats", None)
         if cfn is not None:
             payload["cache"] = cfn()
+        # async double-buffered scheduler: the knob's resolved state
+        # and the live pipeline depth (single-server debug view; the
+        # per-iteration overlap fields ride in flight_recorder records
+        # and the folded `overlap` phase in iteration_profile)
+        ofn = getattr(self.srv, "overlap_stats", None)
+        if ofn is not None:
+            payload["overlap"] = ofn()
         # speculative decoding: drafted/accepted totals, the accept
         # rate, and (adaptive) the live per-slot draft lengths.
         # ReplicatedRouter's speculation_stats() merges counts across

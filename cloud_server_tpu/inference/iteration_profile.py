@@ -24,13 +24,25 @@ boundaries the scheduler already crosses):
                 the only phase that waits on the accelerator
     commit      token emit / grammar / speculation bookkeeping on the
                 synced results
+    launch      (async scheduler only) the ledger patch + next-
+                dispatch launch that follows the commit — the tail of
+                the serialized critical path when overlap is on
     epilogue    flight-recorder / tracing / SLO bookkeeping at the end
                 of the iteration
 
-`host_gap_frac` = (everything except `device`) / duration: the
+SEQUENTIAL iterations (overlap off, or nothing in flight):
+`host_gap_frac` = (everything except `device`) / duration — the
 fraction of each iteration the device sits idle while the host works.
-That is exactly the headroom item 4's overlap can reclaim — and the
-number that proves (or refutes) it per-phase once it lands.
+
+OVERLAPPED iterations (the async double-buffered scheduler, ROADMAP
+item 4 — now built): sweep / admission / build run WHILE the device
+executes the previous iteration's program, so they are no longer
+device-idle time. Those phases fold into `overlap_ms` (and the single
+`overlap`-labeled histogram series), `device` becomes the RESIDUAL
+wait after the overlapped host work, and `host_gap_frac` measures
+only the serialized host tail (`commit` + `launch` + `epilogue`) —
+the residual cost the overlap could not hide. The per-record identity
+becomes `host_ms + device_wait_ms + overlap_ms == duration_ms`.
 
 Design rules (the metrics layer's own):
 
@@ -73,7 +85,21 @@ from time import perf_counter
 from cloud_server_tpu.utils.serving_metrics import histogram_percentile
 
 # Canonical phase order — the contiguous partition of one iteration.
-PHASES = ("sweep", "admission", "build", "device", "commit", "epilogue")
+# `launch` only appears in overlapped iterations (async scheduler).
+PHASES = ("sweep", "admission", "build", "device", "commit", "launch",
+          "epilogue")
+
+# Phases that run concurrently with the in-flight device program when
+# the async double-buffered scheduler has a dispatch outstanding; they
+# fold into the `overlap` histogram label and `overlap_ms`.
+OVERLAP_PHASES = ("sweep", "admission", "build")
+
+# Histogram label set: the fine-grained phases plus the folded
+# `overlap` series overlapped iterations observe instead of their
+# sweep/admission/build split (keeping `profile_summary`'s host-gap
+# arithmetic honest across sequential and overlapped iterations — the
+# fine split of overlapped iterations stays in the flight records).
+HIST_PHASES = PHASES + ("overlap",)
 
 # Millisecond bucket ladder for the per-phase histograms: sub-0.1 ms
 # host blips through multi-second cold dispatches. Fixed at
@@ -92,7 +118,9 @@ _FULL_FAMILY = f"cloud_server_{PHASE_FAMILY}"
 _ITER_ARG_KEYS = ("iteration", "scheduler", "n_live", "decode_rounds",
                   "decode_tokens", "prefill_tokens", "tokens_scheduled",
                   "budget_utilization", "host_ms", "device_wait_ms",
-                  "host_gap_frac", "preemptions", "pending", "n_jobs")
+                  "host_gap_frac", "preemptions", "pending", "n_jobs",
+                  "overlap", "overlap_ms", "inflight_depth",
+                  "overlap_launch_lead_ms")
 
 
 class IterationProfiler:
@@ -148,7 +176,7 @@ def register_phase_hists(registry) -> dict:
             PHASE_FAMILY,
             "Scheduler iteration time by phase (milliseconds)",
             buckets=PHASE_MS_BUCKETS, labels={"phase": p})
-        for p in PHASES}
+        for p in HIST_PHASES}
 
 
 def resolve_profiler(profile,
@@ -172,11 +200,28 @@ def resolve_profiler(profile,
 
 
 def derive_gap_fields(phases_ms: dict[str, float],
-                      duration_ms: float) -> dict[str, float]:
+                      duration_ms: float,
+                      overlapped: bool = False) -> dict[str, float]:
     """The derived flight-record fields from one iteration's phase
-    split: host milliseconds (everything except the device wait), the
-    device wait itself, and the host-gap fraction of the iteration."""
+    split: host milliseconds (the SERIALIZED host work), the device
+    wait, and the host-gap fraction of the iteration.
+
+    Sequential iterations (`overlapped=False`): host = everything
+    except `device` — the historical definition, byte-identical.
+    Overlapped iterations: sweep/admission/build ran concurrently with
+    the in-flight device program, so they move into `overlap_ms`;
+    `host_ms` keeps only the residual serialized tail (commit + launch
+    + epilogue) and `host_gap_frac` therefore measures what the
+    overlap could NOT hide."""
     device = phases_ms.get("device", 0.0)
+    if overlapped:
+        overlap = sum(phases_ms.get(p, 0.0) for p in OVERLAP_PHASES)
+        host = sum(v for k, v in phases_ms.items()
+                   if k != "device" and k not in OVERLAP_PHASES)
+        return {"host_ms": host, "device_wait_ms": device,
+                "overlap_ms": overlap,
+                "host_gap_frac": host / duration_ms if duration_ms > 0
+                else 0.0}
     host = sum(v for k, v in phases_ms.items() if k != "device")
     return {"host_ms": host, "device_wait_ms": device,
             "host_gap_frac": host / duration_ms if duration_ms > 0
@@ -193,7 +238,7 @@ def profile_summary(snapshot: dict) -> dict | None:
     phase histograms are present (profiling disabled, or a backend
     without it)."""
     phases: dict[str, dict] = {}
-    host_ms = device_ms = 0.0
+    host_ms = device_ms = overlap_ms = 0.0
     for key, entry in snapshot.items():
         if not key.startswith(_FULL_FAMILY + "{") \
                 or entry.get("type") != "histogram":
@@ -209,14 +254,20 @@ def profile_summary(snapshot: dict) -> dict | None:
             "p99_ms": histogram_percentile(entry, 0.99)}
         if phase == "device":
             device_ms += entry["sum"]
+        elif phase == "overlap":
+            # host work performed while a dispatch was in flight (the
+            # async scheduler's hidden sweep/admission/build): not
+            # device-idle time, so not host gap
+            overlap_ms += entry["sum"]
         else:
             host_ms += entry["sum"]
     if not phases:
         return None
-    total = host_ms + device_ms
-    return {"phases": {p: phases[p] for p in PHASES if p in phases},
+    total = host_ms + device_ms + overlap_ms
+    return {"phases": {p: phases[p] for p in HIST_PHASES if p in phases},
             "host_ms_total": host_ms,
             "device_wait_ms_total": device_ms,
+            "overlap_ms_total": overlap_ms,
             "host_gap_frac": host_ms / total if total > 0 else 0.0}
 
 
@@ -238,9 +289,21 @@ def scheduler_chrome_trace(records: list[dict]) -> dict:
     is its per-iteration SUM (chunks interleave build/device several
     times), so bar order within an iteration is attribution, not a
     literal interleaving. Records written with profiling disabled
-    carry no `t_start`/`phases_ms` and are skipped."""
+    carry no `t_start`/`phases_ms` and are skipped.
+
+    OVERLAPPED iterations (the async double-buffered scheduler) are
+    NOT disjoint in device time: the program committed by iteration
+    k+1 was launched inside iteration k's window. Each record that
+    launched ahead carries `t_launch`, and the export renders an
+    `inflight` track whose slices span from that launch to the END of
+    the NEXT record's residual `device` wait — so the device slice
+    visibly runs CONCURRENT with (nested under) the next iteration's
+    sweep/admission/build bars instead of the export pretending
+    iteration bounds partition device time."""
     events: list[dict] = []
     seen_pids: set[int] = set()
+    inflight_tid = len(PHASES) + 1
+    last_launch: dict[int, tuple[float, int]] = {}  # pid -> (ts, iter)
     for rec in records:
         t0 = rec.get("t_start")
         if t0 is None:
@@ -257,6 +320,9 @@ def scheduler_chrome_trace(records: list[dict]) -> dict:
                 events.append({"ph": "M", "name": "thread_name",
                                "pid": pid, "tid": i + 1,
                                "args": {"name": p}})
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": inflight_tid,
+                           "args": {"name": "inflight"}})
         args = {k: rec[k] for k in _ITER_ARG_KEYS if k in rec}
         events.append({"ph": "X",
                        "name": f"iteration {rec.get('iteration')}",
@@ -264,6 +330,7 @@ def scheduler_chrome_trace(records: list[dict]) -> dict:
                        "dur": rec.get("duration_ms", 0.0) * 1e3,
                        "pid": pid, "tid": 0, "args": args})
         off = t0 * 1e6
+        device_end = None
         for i, p in enumerate(PHASES):
             v = (rec.get("phases_ms") or {}).get(p, 0.0)
             if v <= 0:
@@ -272,4 +339,22 @@ def scheduler_chrome_trace(records: list[dict]) -> dict:
                            "dur": v * 1e3, "pid": pid, "tid": i + 1,
                            "args": {"iteration": rec.get("iteration")}})
             off += v * 1e3
+            if p == "device":
+                device_end = off
+        if rec.get("overlap") and pid in last_launch \
+                and device_end is not None:
+            # the dispatch THIS record committed: launched inside the
+            # previous record's window, device-resident until this
+            # record's residual sync — one concurrent slice
+            ts_launch, it_launch = last_launch.pop(pid)
+            events.append({"ph": "X",
+                           "name": f"dispatch (committed by iteration "
+                                   f"{rec.get('iteration')})",
+                           "ts": ts_launch * 1e6,
+                           "dur": max(device_end - ts_launch * 1e6, 0.0),
+                           "pid": pid, "tid": inflight_tid,
+                           "args": {"launched_in_iteration": it_launch,
+                                    "iteration": rec.get("iteration")}})
+        if rec.get("t_launch") is not None:
+            last_launch[pid] = (rec["t_launch"], rec.get("iteration"))
     return {"traceEvents": events, "displayTimeUnit": "ms"}

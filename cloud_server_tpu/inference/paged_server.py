@@ -65,6 +65,32 @@ the contiguous slot cache). What the paged design buys:
     committed tokens while rejected draft work lands on a per-tenant
     wasted-speculation counter.
 
+  * ASYNC DOUBLE-BUFFERED SCHEDULING (`InferConfig.overlap` /
+    `overlap=`, default on; mixed scheduler only): JAX dispatch is
+    async, so the scheduler pipelines the loop instead of serializing
+    host policy against the device. Each step plans iteration N+1 —
+    sweep, QoS/DRR admission, deadline checks, chain growth, and the
+    whole numpy dispatch build — against the last COMMITTED ledger
+    plus the in-flight dispatch's deterministic effects (job cursors
+    advance by the takes it was launched with; planned lengths use
+    the worst-case rounds*window bound) WHILE the device executes
+    iteration N; then it pays the one sanctioned `device_get` commit,
+    patches the handful of data-dependent inputs (row lengths / last
+    tokens / the live mask, re-read from the just-committed ledger),
+    and launches N+1. Only the commit + patch + launch tail stays on
+    the serialized critical path — `host_gap_frac` in the flight
+    records measures exactly that residual. Write-safety: while a
+    dispatch is in flight the planner NEVER releases pages (no
+    preemption, no slot teardown — sweep reaps are deferred to just
+    after the commit), statically enforced by the dispatch-discipline
+    pass's DD5 rule; on page famine the plan degrades its round count
+    and the pipeline drains so the next sequential iteration can run
+    the full preemption escalation. Greedy and seeded outputs are
+    token-for-token identical with overlap on or off (scheduling is
+    output-invariant by the same property the mixed/alternating
+    parity pins); overlap=False falls back to the byte-identical
+    sequential loop.
+
 Scheduling state is HOST-authoritative (tables, lengths, active,
 last_token live in numpy and ride into each dispatch as small inputs);
 the device owns only the big buffers (page pools + per-slot token
@@ -110,7 +136,8 @@ from cloud_server_tpu.config import InferConfig, ModelConfig
 from cloud_server_tpu.inference import paged_engine, sampling
 from cloud_server_tpu.inference.block_allocator import BlockAllocator
 from cloud_server_tpu.inference.grammar import DEAD as _GDEAD
-from cloud_server_tpu.inference.iteration_profile import derive_gap_fields
+from cloud_server_tpu.inference.iteration_profile import (
+    OVERLAP_PHASES, derive_gap_fields)
 from cloud_server_tpu.inference.sampling import (
     SamplingParams, SamplingRows, make_rows, sample_from_probs,
     sample_logits, sample_logits_rows, sampling_probs,
@@ -846,6 +873,75 @@ class _AdmitJob:
     got: np.ndarray                # bool — sample captured yet
     next_chunk: int = 0
     done: int = 0                  # mixed: remainder tokens prefilled
+    # async scheduler: remainder tokens DISPATCHED (committed done +
+    # whatever the in-flight dispatch carries). The overlap planner
+    # selects chunks from this cursor so a launch-ahead iteration can
+    # never re-prefill tokens already in flight; `done` catches up at
+    # each commit, and the two are equal whenever nothing is in flight.
+    planned: int = 0
+
+
+@dataclasses.dataclass
+class _Plan:
+    """An immutable-by-convention PLANNED iteration (async scheduler):
+    everything the launch needs, built against the planned frame while
+    the previous dispatch runs. The only fields `_launch_plan` rewrites
+    post-commit are the data-dependent decode inputs (d_lens / d_last /
+    d_tables / live_g — a handful of (rows,) gathers from the
+    just-committed ledger); every policy decision and every other
+    array is frozen here."""
+
+    kind: str                       # "mixed" | "decode"
+    sel: list                       # [(job, take, d0)] — empty for decode
+    activating: list                # slot ids whose admission completes
+    n_rounds: int
+    win: int                        # g_iter + 1
+    g_iter: int
+    spec_lens: list | None
+    live_ids: np.ndarray
+    sl_d: np.ndarray | None
+    live_g: np.ndarray
+    d_lens: np.ndarray
+    d_tables: np.ndarray
+    d_last: np.ndarray
+    d_stop: np.ndarray
+    samp_d: object
+    gid_d: np.ndarray
+    aid_d: np.ndarray
+    owners: list                    # _Slot per live row (identity guard)
+    pf: dict | None                 # prefill-half arrays (mixed only)
+    scatter_prompt: bool
+    use_rows_p: bool
+    use_bias_p: bool
+    use_rows_d: bool
+    use_bias_d: bool
+    use_grammar: bool
+    use_lora: bool
+    stats: dict
+    spans: list
+
+
+@dataclasses.dataclass
+class _Inflight:
+    """One launched-but-uncommitted dispatch (async scheduler): the
+    device futures plus exactly the host context `_commit_inflight`
+    needs to scatter the synced results back — and the deterministic
+    effects (`activating`, per-row upper bounds via n_rounds*win) the
+    NEXT plan's frame is built from."""
+
+    kind: str
+    futures: tuple
+    sel: list
+    activating: list
+    live_ids: np.ndarray
+    owners: list
+    n_rounds: int
+    win: int
+    g_iter: int
+    spec_lens: list | None
+    stats: dict
+    spans: list
+    t_launch: float
 
 
 class PagedInferenceServer:
@@ -872,7 +968,8 @@ class PagedInferenceServer:
                  metrics: ServingMetrics | None = None,
                  flight_recorder_size: int | None = None,
                  qos=None, tracing=None, slo=None, spec_control=None,
-                 iteration_profile=None, faults=None, brownout=None):
+                 iteration_profile=None, faults=None, brownout=None,
+                 overlap: bool | None = None):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -1231,7 +1328,27 @@ class PagedInferenceServer:
             set(_pow2_buckets(16, self.prefill_chunk))
             | {_pad_pow2(self.window)})
         self._lock = threading.Lock()
+        # submit() notifies this condition (same mutex as _lock) so
+        # an idle serve_forever parks in a bounded wait instead of
+        # busy-polling — new work wakes it immediately (cancel needs
+        # no notify: an idle-waiting scheduler implies nothing left
+        # to cancel); stop() notifies for prompt shutdown
+        self._work = threading.Condition(self._lock)
         self._step_lock = threading.Lock()
+        # Async double-buffered scheduling (the module docstring's
+        # overlap section): mixed scheduler only — the alternating
+        # scheduler keeps its sequential per-chunk loop.
+        ov = infer_cfg.overlap if overlap is None else bool(overlap)
+        self.overlap = bool(ov)
+        self._overlap_enabled = self.overlap and self._mixed_enabled
+        self._inflight: _Inflight | None = None
+        # deferred sweep reaps: (slot_id, _Slot, reason) marked while a
+        # dispatch is in flight; released right after its commit
+        self._reaped: list[tuple[int, _Slot, str]] = []
+        # perf_counter stamp of the launch performed THIS iteration
+        # (consumed by _record_iteration into the flight record's
+        # t_launch — the Perfetto inflight track's left edge)
+        self._iter_launch_ts: float | None = None
         self._rng = jax.random.key(seed)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -1359,6 +1476,10 @@ class PagedInferenceServer:
             req.record_event("submit", req.submit_time)
             self.metrics.observe_submit(req)
             self._pending.append(req)
+            # wake an idle scheduler thread parked on the bounded
+            # condition wait (serve_forever) — submit latency must not
+            # pay the idle-wait timeout
+            self._work.notify()
         return req
 
     def _handle_cancel(self, req: Request) -> None:
@@ -2024,16 +2145,21 @@ class PagedInferenceServer:
                 break
         return n_eff
 
-    def _chunk_rounds(self) -> int:
+    def _chunk_rounds(self, active=None) -> int:
         """Rounds this dispatch: bounded by decode_chunk — SHRUNK to
         admit_decode_chunk while admission jobs are in flight, so a
         landing prompt is not stuck behind full decode bursts between
         its prefill chunks (this is the TTFT-vs-throughput knob; see
         __init__) — and by the tightest remaining budget (in rounds),
-        rounded down to a power of two."""
+        rounded down to a power of two. `active` overrides the live
+        mask (the overlap planner's PLANNED frame; its slightly stale
+        remaining budgets can only overshoot, which the host emit loop
+        already truncates — the mid-scan EOS case)."""
+        if active is None:
+            active = self.active
         rem = [s.req.max_new_tokens - len(s.req.tokens)
                for i, s in enumerate(self._slots)
-               if s is not None and self.active[i]]
+               if s is not None and active[i]]
         if not rem:
             return 1
         chunk = self.decode_chunk
@@ -2045,7 +2171,7 @@ class PagedInferenceServer:
             p *= 2
         return p
 
-    def _gather_decode_rows(self):
+    def _gather_decode_rows(self, active=None):
         """COMPACTED decode sub-batch: one row per LIVE slot, padded to
         a power of two (compile cache). Rows carry sentinel slot ids /
         tables past the live count, so their writes drop everywhere
@@ -2058,10 +2184,16 @@ class PagedInferenceServer:
         slots): steady state keeps the pre-compaction program, so the
         identity gathers of gstate / penalty rows are never paid there.
 
-        Returns (live_ids, sl, arrays...) for the decode cores."""
-        live_ids = np.flatnonzero(self.active)
+        Returns (live_ids, sl, arrays...) for the decode cores.
+        `active` overrides the live mask (the overlap planner's
+        planned frame; the gathered lengths/last rows are placeholders
+        there — `_launch_plan` re-reads them from the committed ledger
+        right before the launch)."""
+        if active is None:
+            active = self.active
+        live_ids = np.flatnonzero(active)
         if len(live_ids) == self.max_slots:
-            return (live_ids, None, self.active.copy(), self.lengths,
+            return (live_ids, None, active.copy(), self.lengths,
                     self.tables, self.last_token, self.stop_len,
                     self.samp_rows, self._gid, self._aid)
         bg = _pad_pow2(max(len(live_ids), 1))
@@ -2120,14 +2252,18 @@ class PagedInferenceServer:
             return None
         return spec_lens if spec_lens is not None else [g_iter] * nl
 
-    def _stage_spec_stats(self, g_iter: int, n_live: int) -> None:
+    def _stage_spec_stats(self, g_iter: int, n_live: int,
+                          st: dict | None = None) -> None:
         """Flight-recorder speculation fields for this iteration:
         draft rows funded, the dispatch draft count, and (adaptive)
         the current per-slot draft lengths. Token drafted/accepted
-        fields land post-commit in `_commit_decode_rows`."""
+        fields land post-commit in `_commit_decode_rows`. `st`
+        overrides the destination (a launch-ahead plan's staged
+        stats)."""
         if self.spec_drafts <= 0:
             return
-        st = self._iter_stats
+        if st is None:
+            st = self._iter_stats
         st["spec_rows"] = n_live if g_iter > 0 else 0
         st["spec_window"] = g_iter + 1 if g_iter > 0 else 1
         if self.spec_control is not None:
@@ -2221,9 +2357,19 @@ class PagedInferenceServer:
             prof.mark("commit")
 
     def _commit_decode_rows(self, live_ids, toks, lps, counts, lens,
-                            last, drafted=None) -> None:
+                            last, drafted=None, owners=None) -> None:
         """Scatter a compacted decode dispatch's results back to slots
-        and emit (shared by _decode_dispatch and _mixed_dispatch).
+        and emit (shared by _decode_dispatch, _mixed_dispatch, and the
+        async scheduler's _commit_inflight).
+
+        `owners` (async scheduler only): the _Slot object each row was
+        planned for. Between a launch-ahead and its commit a whole
+        step ran — a row's slot may have been released and RE-OCCUPIED
+        by a new admission, so the ledger writes and the emit loop
+        must be identity-guarded per row, not just active-guarded.
+        None (the sequential paths, where nothing can change between
+        dispatch and commit) keeps the historical unconditional
+        writes.
 
         `drafted` (per-live-row drafted-token counts, None when no
         draft rows ran) funds the speculation ledger from numbers the
@@ -2237,8 +2383,15 @@ class PagedInferenceServer:
         lens = np.asarray(lens)
         last = np.asarray(last)
         counts = np.asarray(counts)
-        self.lengths[live_ids] = lens[:nl]
-        self.last_token[live_ids] = last[:nl]
+        if owners is None:
+            self.lengths[live_ids] = lens[:nl]
+            self.last_token[live_ids] = last[:nl]
+        else:
+            for i in range(nl):
+                sid = int(live_ids[i])
+                if self._slots[sid] is owners[i] and self.active[sid]:
+                    self.lengths[sid] = lens[i]
+                    self.last_token[sid] = last[i]
         self.decode_rounds += int(counts.shape[0]) * nl
         self.decode_tokens_committed += int(counts.sum())
         sp_drafted = sp_accepted = 0
@@ -2246,7 +2399,8 @@ class PagedInferenceServer:
         for r in range(toks.shape[0]):
             for i, sid in enumerate(live_ids):
                 slot = self._slots[sid]
-                if slot is None or not self.active[sid]:
+                if slot is None or not self.active[sid] \
+                        or (owners is not None and slot is not owners[i]):
                     continue
                 c = int(counts[r, i])
                 if drafted is not None and c > 0:
@@ -2276,10 +2430,49 @@ class PagedInferenceServer:
             for tenant, (dd, aa) in spec_by_tenant.items():
                 self.qos.charge_speculation(tenant, dd, aa)
 
+    def _complete_admission_chunks(self, sel, ptoks, plps) -> None:
+        """Prefill progress on the synced first-token candidates:
+        capture samples in range, advance `done` (and the `planned`
+        cursor when nothing is in flight to keep them ahead of it),
+        and ACTIVATE completed admissions — the cancel-at-activation
+        check included. THE one completion block, shared by
+        `_mixed_dispatch` (sequential) and `_commit_inflight`
+        (async), so the two paths can never drift."""
+        ptoks, plps = np.asarray(ptoks), np.asarray(plps)
+        for i, (job, take, d0) in enumerate(sel):
+            sid = job.slots[0]
+            rl = int(job.rem_lens[0])
+            if d0 <= rl - 1 < d0 + take:
+                job.toks[0] = ptoks[i]
+                job.lps[0] = plps[i]
+                job.got[0] = True
+            job.done = d0 + take
+            job.planned = max(job.planned, job.done)
+            if job.done < rl:
+                continue
+            slot = self._slots[sid]
+            assert bool(job.got[0]), \
+                "first-token sample never captured"
+            self.lengths[sid] = len(slot.prompt)
+            self.last_token[sid] = int(job.toks[0])
+            if slot.req._cancel.is_set():
+                # cancelled mid-admission: release without ever
+                # activating (the prefilled KV keys into the radix
+                # cache — a resubmit would reuse it)
+                slot = self._release_slot(sid, self._committed(sid))
+                slot.req.finish_reason = "cancelled"
+                self._complete(slot.req)
+            else:
+                self.active[sid] = True
+                if self._emit(slot.req, int(job.toks[0]),
+                              float(job.lps[0])):
+                    self._finish(sid)
+            self._jobs.remove(job)
+
     # -- mixed (stall-free) scheduling --------------------------------------
 
     def _mixed_rounds(self, n_live: int, prefill_demand: int,
-                      win: int) -> int:
+                      win: int, active=None) -> int:
         """Decode rounds for a mixed iteration: the full steady-state
         count (`_chunk_rounds` WITHOUT the admit shrink — not stalling
         decode is the point), then squeezed to leave the budget at least
@@ -2287,10 +2480,14 @@ class PagedInferenceServer:
         at one round and kept a power of two (compile cache). `win` is
         THIS iteration's decode window (current max draft length + 1 —
         adaptive speculation shrinks it with demand), so a slot's
-        decode claim against the budget is its honest token count."""
+        decode claim against the budget is its honest token count.
+        `active` overrides the live mask (the overlap planner's
+        planned frame — see _chunk_rounds)."""
+        if active is None:
+            active = self.active
         rem = [s.req.max_new_tokens - len(s.req.tokens)
                for i, s in enumerate(self._slots)
-               if s is not None and self.active[i]]
+               if s is not None and active[i]]
         if not rem or not n_live:
             return 0
         n = max(1, min(self.decode_chunk, -(-min(rem) // win)))
@@ -2302,6 +2499,113 @@ class PagedInferenceServer:
         while p * 2 <= n:
             p *= 2
         return p
+
+    def _select_prefill(self, jobs, n_live: int, win: int,
+                        n_rounds: int, planned: bool):
+        """Token-budget prefill selection — THE shared policy half of
+        a mixed iteration, used by `_mixed_dispatch` (cursor = the
+        committed `done`) and `_plan_iteration` (cursor = the
+        in-flight-inclusive `planned`), so the two paths can never
+        drift (the array-staging half is `_build_prefill_group`).
+        QoS virtual-time (or FIFO) order; decode rows are funded
+        first, each selected job takes up to `prefill_chunk` tokens
+        of the remainder, and when decode alone saturates the budget
+        the OLDEST admission still gets one minimal chunk (TTFT stays
+        bounded). Returns [(job, take, cursor_offset)]."""
+
+        def cur(j):
+            return j.planned if planned else j.done
+
+        if self.qos is not None and jobs:
+            order = self.qos.order_jobs(
+                [self._slots[j.slots[0]].req.tenant for j in jobs])
+            jobs = [jobs[i] for i in order]
+        sel: list[tuple[_AdmitJob, int, int]] = []
+        left = self.mixed_token_budget - n_live * win * n_rounds
+        for job in jobs:
+            if left <= 0:
+                break
+            rem_left = int(job.rem_lens[0]) - cur(job)
+            take = min(rem_left, left, self.prefill_chunk)
+            if take <= 0:
+                continue
+            sel.append((job, take, cur(job)))
+            left -= take
+        if jobs and not sel:
+            job = jobs[0]
+            take = min(int(job.rem_lens[0]) - cur(job),
+                       self._rem_buckets[0])
+            sel = [(job, take, cur(job))]
+        return sel
+
+    def _build_prefill_group(self, sel) -> dict:
+        """Numpy staging for the ragged prefill half of one mixed
+        iteration: one row per selected admission chunk, each at its
+        own width, padded to a pow2 row count and a bucketed max width
+        (compile cache). `sel` entries are (job, take, d0) — d0 is the
+        remainder offset this chunk starts at: the committed cursor on
+        the sequential path, the PLANNED cursor on the async path (so
+        a launch-ahead iteration never re-prefills tokens already in
+        flight). Shared verbatim by `_mixed_dispatch` and
+        `_plan_iteration` so the two paths can never drift."""
+        pad_tok = self.infer_cfg.pad_token_id
+        b = self.max_slots
+        g = len(sel)
+        gp = _pad_pow2(max(g, 1))
+        w = _bucket(max([t for _, t, _ in sel] + [1]),
+                    self._mixed_buckets)
+        chunk = np.full((gp, w), pad_tok, np.int32)
+        widths = np.zeros((gp,), np.int32)
+        g_lens = np.zeros((gp,), np.int32)
+        g_tables = np.full((gp, self.max_pages_per_slot),
+                           self.allocator.num_pages, np.int32)
+        sample_at = np.zeros((gp,), np.int32)
+        slot_ids = np.full((gp,), self.max_slots, np.int32)
+        countm = np.zeros((gp,), bool)
+        scatm = np.zeros((gp,), bool)
+        scat_plens = []
+        for i, (job, take, d0) in enumerate(sel):
+            sid = job.slots[0]
+            rl = int(job.rem_lens[0])
+            chunk[i, :take] = job.rows[0, d0:d0 + take]
+            widths[i] = take
+            g_lens[i] = int(job.base_lens[0]) + d0
+            g_tables[i] = self.tables[sid]
+            sample_at[i] = min(max(rl - 1 - d0, 0), take - 1)
+            slot_ids[i] = sid
+            countm[i] = d0 <= rl - 1 < d0 + take
+            scatm[i] = d0 == 0
+            if d0 == 0:
+                scat_plens.append(int(job.prompt_lens[0]))
+        pb = (_bucket(max(scat_plens), self._admit_buckets)
+              if scat_plens else self._admit_buckets[0])
+        prompt_rows = np.full((gp, pb), pad_tok, np.int32)
+        prompt_lens = np.zeros((gp,), np.int32)
+        orig_lens = np.zeros((gp,), np.int32)
+        for i, (job, take, d0) in enumerate(sel):
+            sid = job.slots[0]
+            pl = int(job.prompt_lens[0])
+            prompt_lens[i] = pl
+            orig_lens[i] = self.orig_len[sid]
+            if d0 == 0:
+                prompt_rows[i, :pl] = job.prompt_rows[0, :pl]
+        sl_real = np.clip(slot_ids, 0, self.max_slots - 1)
+        samp_g = _gather_samp_rows(self.samp_rows, sl_real, g)
+        gid_g = self._gid[sl_real].copy()
+        gid_g[g:] = 0
+        gst0_g = self._gstate0[sl_real].copy()
+        gst0_g[g:] = 0
+        aid_g = self._aid[sl_real].copy()
+        aid_g[g:] = 0
+        sel_mask = np.zeros((b,), bool)
+        sel_mask[[job.slots[0] for job, _, _ in sel]] = True
+        return {"chunk": chunk, "widths": widths, "g_lens": g_lens,
+                "g_tables": g_tables, "sample_at": sample_at,
+                "slot_ids": slot_ids, "prompt_rows": prompt_rows,
+                "prompt_lens": prompt_lens, "samp_g": samp_g,
+                "orig_lens": orig_lens, "countm": countm,
+                "scatm": scatm, "gid_g": gid_g, "gst0_g": gst0_g,
+                "aid_g": aid_g, "sel_mask": sel_mask}
 
     def _mixed_dispatch(self) -> None:
         """One token-budget iteration: the multi-round decode dispatch
@@ -2348,35 +2652,13 @@ class PagedInferenceServer:
         g_iter, spec_lens = self._spec_plan(np.flatnonzero(self.active))
         win = g_iter + 1
 
-        jobs = self._jobs
-        if self.qos is not None and jobs:
-            # weighted-fair funding of the iteration's prefill chunks:
-            # jobs ordered by their tenant's prefill virtual time
-            # (spent-tokens / weight; FIFO within a tenant) instead of
-            # plain FIFO — with one tenant the order is the identity,
-            # i.e. exactly the FIFO below. Called even for a single
-            # job: it also advances the global virtual time, so a
-            # tenant arriving after an idle gap resumes at the current
-            # time instead of replaying idle credit.
-            order = self.qos.order_jobs(
-                [self._slots[j.slots[0]].req.tenant for j in jobs])
-            jobs = [self._jobs[i] for i in order]
-        sel: list[tuple[_AdmitJob, int]] = []
-        left = self.mixed_token_budget - n_live * win * n_rounds
-        for job in jobs:
-            if left <= 0:
-                break
-            rem_left = int(job.rem_lens[0]) - job.done
-            take = min(rem_left, left, self.prefill_chunk)
-            if take <= 0:
-                continue
-            sel.append((job, take))
-            left -= take
-        if jobs and not sel:
-            job = jobs[0]
-            take = min(int(job.rem_lens[0]) - job.done,
-                       self._rem_buckets[0])
-            sel = [(job, take)]
+        # weighted-fair funding of the iteration's prefill chunks
+        # (QoS virtual-time order inside _select_prefill; called even
+        # for a single job — it also advances the global virtual time,
+        # so a tenant arriving after an idle gap resumes at the
+        # current time instead of replaying idle credit)
+        sel = self._select_prefill(self._jobs, n_live, win, n_rounds,
+                                   planned=False)
         prof = self._profiler
         if prof is not None:
             # budget/round planning, chain extension, QoS funding
@@ -2385,76 +2667,27 @@ class PagedInferenceServer:
         if not sel and not n_rounds:
             return
         if self.qos is not None:
-            for job, take in sel:
+            for job, take, _ in sel:
                 self.qos.charge_prefill(
                     self._slots[job.slots[0]].req.tenant, take)
         self._iter_stats.update(
             scheduler="mixed", n_live=n_live, decode_rounds=n_rounds,
             decode_tokens=n_live * win * n_rounds,
-            prefill_tokens=sum(t for _, t in sel))
+            prefill_tokens=sum(t for _, t, _ in sel))
         if n_rounds > 0:
             self._stage_spec_stats(g_iter, n_live)
         if self.trace_recorder is not None:
-            for job, take in sel:
+            for job, take, d0 in sel:
                 r = self._slots[job.slots[0]].req
                 if r.trace is not None:
                     self._iter_spans.append(
                         (r, "prefill_chunk",
                          {"slot": job.slots[0], "tokens": take,
-                          "offset": job.done}))
+                          "offset": d0}))
 
         # -- ragged prefill group (one row per selected admission) ----------
-        pad_tok = self.infer_cfg.pad_token_id
-        g = len(sel)
-        gp = _pad_pow2(max(g, 1))
-        w = _bucket(max([t for _, t in sel] + [1]), self._mixed_buckets)
-        chunk = np.full((gp, w), pad_tok, np.int32)
-        widths = np.zeros((gp,), np.int32)
-        g_lens = np.zeros((gp,), np.int32)
-        g_tables = np.full((gp, self.max_pages_per_slot),
-                           self.allocator.num_pages, np.int32)
-        sample_at = np.zeros((gp,), np.int32)
-        slot_ids = np.full((gp,), self.max_slots, np.int32)
-        countm = np.zeros((gp,), bool)
-        scatm = np.zeros((gp,), bool)
-        scat_plens = []
-        for i, (job, take) in enumerate(sel):
-            sid = job.slots[0]
-            d0 = job.done
-            rl = int(job.rem_lens[0])
-            chunk[i, :take] = job.rows[0, d0:d0 + take]
-            widths[i] = take
-            g_lens[i] = int(job.base_lens[0]) + d0
-            g_tables[i] = self.tables[sid]
-            sample_at[i] = min(max(rl - 1 - d0, 0), take - 1)
-            slot_ids[i] = sid
-            countm[i] = d0 <= rl - 1 < d0 + take
-            scatm[i] = d0 == 0
-            if d0 == 0:
-                scat_plens.append(int(job.prompt_lens[0]))
-        pb = (_bucket(max(scat_plens), self._admit_buckets)
-              if scat_plens else self._admit_buckets[0])
-        prompt_rows = np.full((gp, pb), pad_tok, np.int32)
-        prompt_lens = np.zeros((gp,), np.int32)
-        orig_lens = np.zeros((gp,), np.int32)
-        for i, (job, take) in enumerate(sel):
-            sid = job.slots[0]
-            pl = int(job.prompt_lens[0])
-            prompt_lens[i] = pl
-            orig_lens[i] = self.orig_len[sid]
-            if job.done == 0:
-                prompt_rows[i, :pl] = job.prompt_rows[0, :pl]
-        sl = slot_ids.copy()
-        sl_real = np.clip(sl, 0, self.max_slots - 1)
-        samp_g = _gather_samp_rows(self.samp_rows, sl_real, g)
-        gid_g = self._gid[sl_real].copy()
-        gid_g[g:] = 0
-        gst0_g = self._gstate0[sl_real].copy()
-        gst0_g[g:] = 0
-        aid_g = self._aid[sl_real].copy()
-        aid_g[g:] = 0
-        sel_mask = np.zeros((b,), bool)
-        sel_mask[[job.slots[0] for job, _ in sel]] = True
+        pf = self._build_prefill_group(sel)
+        sel_mask = pf["sel_mask"]
         use_rows_p = bool((self._needs_rows & sel_mask).any())
         use_bias_p = bool((self._has_bias & sel_mask).any())
 
@@ -2481,15 +2714,15 @@ class PagedInferenceServer:
             prof.mark("build")
         self.state, ptoks, plps, lens, last, (toks, lps, counts) = \
             _mixed_step(
-                self.params, self.state, jnp.asarray(chunk),
-                jnp.asarray(widths), jnp.asarray(g_lens),
-                jnp.asarray(g_tables), jnp.asarray(sample_at),
-                jnp.asarray(slot_ids), jnp.asarray(prompt_rows),
-                jnp.asarray(prompt_lens),
-                jax.tree.map(jnp.asarray, samp_g),
-                jnp.asarray(orig_lens), jnp.asarray(countm),
-                jnp.asarray(scatm), jnp.asarray(gid_g),
-                jnp.asarray(gst0_g),
+                self.params, self.state, jnp.asarray(pf["chunk"]),
+                jnp.asarray(pf["widths"]), jnp.asarray(pf["g_lens"]),
+                jnp.asarray(pf["g_tables"]), jnp.asarray(pf["sample_at"]),
+                jnp.asarray(pf["slot_ids"]), jnp.asarray(pf["prompt_rows"]),
+                jnp.asarray(pf["prompt_lens"]),
+                jax.tree.map(jnp.asarray, pf["samp_g"]),
+                jnp.asarray(pf["orig_lens"]), jnp.asarray(pf["countm"]),
+                jnp.asarray(pf["scatm"]), jnp.asarray(pf["gid_g"]),
+                jnp.asarray(pf["gst0_g"]),
                 jnp.asarray(d_lens), jnp.asarray(d_tables),
                 jnp.asarray(d_last), jnp.asarray(live_g),
                 jnp.asarray(d_stop),
@@ -2503,11 +2736,11 @@ class PagedInferenceServer:
                 # reference, rebuilt under _lock pre-admission
                 self._grammar_dev if use_grammar else None,
                 self.adapters.device_args() if use_lora else None,
-                jnp.asarray(aid_g), jnp.asarray(aid_d),
+                jnp.asarray(pf["aid_g"]), jnp.asarray(aid_d),
                 self.draft_params,
                 cfg=self.cfg, infer_cfg=self.infer_cfg,
                 n_rounds=n_rounds, n_drafts=g_iter,
-                scatter_prompt=bool(scatm.any()), mesh=self.mesh,
+                scatter_prompt=bool(pf["scatm"].any()), mesh=self.mesh,
                 draft_cfg=self.draft_cfg,
                 use_rows_p=use_rows_p, use_bias_p=use_bias_p,
                 use_rows_d=use_rows_d, use_bias_d=use_bias_d)
@@ -2531,34 +2764,553 @@ class PagedInferenceServer:
 
         # prefill progress: capture first tokens, activate completed
         # admissions (mirrors _run_one_chunk's completion block)
-        ptoks, plps = np.asarray(ptoks), np.asarray(plps)
-        for i, (job, take) in enumerate(sel):
-            sid = job.slots[0]
-            rl = int(job.rem_lens[0])
-            d0 = job.done
-            if d0 <= rl - 1 < d0 + take:
-                job.toks[0] = ptoks[i]
-                job.lps[0] = plps[i]
-                job.got[0] = True
-            job.done = d0 + take
-            if job.done < rl:
-                continue
-            slot = self._slots[sid]
-            assert bool(job.got[0]), "first-token sample never captured"
-            self.lengths[sid] = len(slot.prompt)
-            self.last_token[sid] = int(job.toks[0])
-            if slot.req._cancel.is_set():
-                slot = self._release_slot(sid, self._committed(sid))
-                slot.req.finish_reason = "cancelled"
-                self._complete(slot.req)
-            else:
-                self.active[sid] = True
-                if self._emit(slot.req, int(job.toks[0]),
-                              float(job.lps[0])):
-                    self._finish(sid)
-            self._jobs.remove(job)
+        self._complete_admission_chunks(sel, ptoks, plps)
         if prof is not None:
             prof.mark("commit")
+
+    # -- async double-buffered scheduling (overlap on) ----------------------
+    #
+    # The pipelined loop (see the module docstring's overlap section):
+    # each step plans iteration N+1 against the PLANNED frame while the
+    # device runs iteration N, pays the one sanctioned device_get
+    # commit, patches the plan's data-dependent inputs from the
+    # just-committed ledger, and launches. Functions on this path obey
+    # one extra invariant the dispatch-discipline pass checks
+    # statically (DD5): the PLAN functions never release pages or tear
+    # down slots — a page freed under an in-flight dispatch could be
+    # re-allocated while the device still writes it.
+
+    def _extend_chains_planned(self, n_rounds: int, planned_len,
+                               planned_active) -> int:
+        """Planned-frame chain growth for a launch-ahead dispatch:
+        cover each planned-live slot's worst-case window writes using
+        the PLANNED length upper bound (committed length + the
+        in-flight dispatch's rounds*window). Unlike `_extend_chains`
+        this NEVER preempts or fails a request (DD5 — no page releases
+        while a dispatch is in flight): on famine it takes whatever
+        pages are available and bounds the dispatch to the rounds
+        every chain already covers. 0 drops the decode half; the
+        pipeline then drains, and the next sequential iteration runs
+        the full preemption escalation with nothing in flight."""
+        n_eff = n_rounds
+        for sid in range(self.max_slots):
+            slot = self._slots[sid]
+            if slot is None or not planned_active[sid]:
+                continue
+            need_len = min(int(planned_len[sid])
+                           + n_rounds * self.window,
+                           slot.stop_len + self.window)
+            delta = -(-need_len // self.page_size) - len(slot.pages)
+            if delta > 0:
+                grab = min(delta, self.allocator.available)
+                fresh = (self.allocator.alloc(grab,
+                                              tenant=slot.req.tenant)
+                         if grab > 0 else None)
+                if fresh:
+                    start = len(slot.pages)
+                    slot.pages.extend(fresh)
+                    self.tables[sid, start:len(slot.pages)] = fresh
+            covered = len(slot.pages) * self.page_size
+            r_ok = max(0, (covered - int(planned_len[sid]))
+                       // self.window)
+            n_eff = min(n_eff, r_ok)
+        return n_eff
+
+    def _plan_iteration(self) -> "_Plan | None":
+        """Plan — and numpy-build — the NEXT dispatch against the
+        PLANNED frame: the committed ledger plus the in-flight
+        dispatch's deterministic effects (job cursors advanced by the
+        takes it carries; slots it completes counted live; lengths at
+        their rounds*window upper bound). This is the host policy work
+        the overlap hides under the device: QoS/DRR funding order,
+        token-budget split, chain growth, and all array staging happen
+        here, so after the commit only a (rows,)-sized patch and the
+        launch remain serialized.
+
+        Returns None when there is nothing to dispatch (the pipeline
+        drains). Never mutates the committed ledger beyond job.planned
+        cursors, QoS prefill charges, and chain growth — and never
+        releases pages (DD5).
+
+        The injected-fault "dispatch" site is NOT checked here but in
+        _step_overlap's steady-state path: checking per plan would
+        hit the site twice on a pipeline-fill step (breaking the
+        FaultPlan's one-hit-per-iteration pacing) and could fire
+        AFTER the fill dispatch already streamed tokens — the fill
+        prime's fault site is the NEXT step's check, matching the
+        contiguous server's convention."""
+        prof = self._profiler
+        b = self.max_slots
+        infl = self._inflight
+        # --- the planned frame --------------------------------------------
+        planned_active = self.active.copy()
+        planned_len = self.lengths.copy()
+        if infl is not None:
+            if infl.n_rounds > 0:
+                for i, sid_ in enumerate(infl.live_ids):
+                    sid = int(sid_)
+                    if planned_active[sid] \
+                            and self._slots[sid] is infl.owners[i]:
+                        planned_len[sid] = min(
+                            int(planned_len[sid])
+                            + infl.n_rounds * infl.win,
+                            int(self.stop_len[sid]) + self.window)
+            for sid in infl.activating:
+                slot = self._slots[sid]
+                if slot is not None:
+                    planned_active[sid] = True
+                    planned_len[sid] = len(slot.prompt)
+        jobs = [j for j in self._jobs if j.planned < int(j.rem_lens[0])]
+        if not jobs and not planned_active.any():
+            return None
+        stats: dict = {}
+        spans: list = []
+        if jobs:
+            # --- token-budget mixed iteration (mirrors _mixed_dispatch)
+            demand = sum(int(j.rem_lens[0]) - j.planned for j in jobs)
+            n_live = int(planned_active.sum())
+            # ONE speculation plan per planned iteration: unlike the
+            # sequential path, _extend_chains_planned can never
+            # preempt a slot out of the live set (DD5), so there is
+            # nothing to re-plan after chain growth
+            g_iter, spec_lens = self._spec_plan(
+                np.flatnonzero(planned_active))
+            n_rounds = self._mixed_rounds(n_live, demand, g_iter + 1,
+                                          active=planned_active)
+            if self.allocation == "ondemand" and n_rounds > 0:
+                n_eff = self._extend_chains_planned(
+                    n_rounds, planned_len, planned_active)
+                if n_eff <= 0:
+                    n_rounds = 0
+                else:
+                    while n_rounds > n_eff:
+                        n_rounds //= 2
+                    n_rounds = max(1, n_rounds)
+            live = (planned_active if n_rounds > 0
+                    else np.zeros((b,), bool))
+            n_live = int(live.sum())
+            win = g_iter + 1
+            sel = self._select_prefill(jobs, n_live, win, n_rounds,
+                                       planned=True)
+            if not sel and not n_rounds:
+                return None
+            if self.qos is not None:
+                for job, take, _ in sel:
+                    self.qos.charge_prefill(
+                        self._slots[job.slots[0]].req.tenant, take)
+            activating: list[int] = []
+            for job, take, d0 in sel:
+                job.planned = d0 + take
+                if job.planned >= int(job.rem_lens[0]):
+                    activating.append(job.slots[0])
+            stats.update(
+                scheduler="mixed", n_live=n_live,
+                decode_rounds=n_rounds,
+                decode_tokens=n_live * win * n_rounds,
+                prefill_tokens=sum(t for _, t, _ in sel))
+            if n_rounds > 0:
+                self._stage_spec_stats(g_iter, n_live, st=stats)
+            if self.trace_recorder is not None:
+                for job, take, d0 in sel:
+                    r = self._slots[job.slots[0]].req
+                    if r.trace is not None:
+                        spans.append(
+                            (r, "prefill_chunk",
+                             {"slot": job.slots[0], "tokens": take,
+                              "offset": d0}))
+            if prof is not None:
+                # planned-frame budget/round planning, chain growth,
+                # QoS funding order, selection — overlapped host work
+                prof.mark("admission")
+            pf = self._build_prefill_group(sel)
+            sel_mask = pf["sel_mask"]
+            (live_ids, sl_d, live_g, d_lens, d_tables, d_last, d_stop,
+             samp_d, gid_d, aid_d) = self._gather_decode_rows(live)
+            stats.update(
+                decode_rows=int(live_g.shape[0]) if n_rounds else 0,
+                compaction_ratio=(n_live / max(int(live_g.shape[0]), 1)
+                                  if n_rounds else 1.0))
+            if self.trace_recorder is not None and n_rounds > 0:
+                self._stage_decode_spans(live_ids, n_rounds, out=spans)
+            if n_rounds == 0:
+                live_g = np.zeros_like(live_g)
+            plan = _Plan(
+                kind="mixed", sel=sel, activating=activating,
+                n_rounds=n_rounds, win=win, g_iter=g_iter,
+                spec_lens=spec_lens, live_ids=live_ids, sl_d=sl_d,
+                live_g=live_g, d_lens=d_lens, d_tables=d_tables,
+                d_last=d_last, d_stop=d_stop, samp_d=samp_d,
+                gid_d=gid_d, aid_d=aid_d,
+                owners=[self._slots[int(s)] for s in live_ids],
+                pf=pf, scatter_prompt=bool(pf["scatm"].any()),
+                use_rows_p=bool((self._needs_rows & sel_mask).any()),
+                use_bias_p=bool((self._has_bias & sel_mask).any()),
+                use_rows_d=bool((self._needs_rows & live).any()),
+                use_bias_d=bool((self._has_bias & live).any()),
+                use_grammar=bool(
+                    ((self._gid > 0) & (live | sel_mask)).any()),
+                use_lora=bool(
+                    ((self._aid > 0) & (live | sel_mask)).any()),
+                stats=stats, spans=spans)
+        else:
+            # --- pure-decode iteration (mirrors _decode_dispatch) ---------
+            n = self._chunk_rounds(active=planned_active)
+            if self.allocation == "ondemand":
+                n_eff = self._extend_chains_planned(
+                    n, planned_len, planned_active)
+                if n_eff <= 0:
+                    return None
+                while n > n_eff:
+                    n //= 2
+                n = max(1, n)
+            if prof is not None:
+                prof.mark("admission")
+            (live_ids, sl_d, live_g, d_lens, d_tables, d_last, d_stop,
+             samp_d, gid_d, aid_d) = self._gather_decode_rows(
+                 planned_active)
+            g_iter, spec_lens = self._spec_plan(live_ids)
+            stats.update(
+                scheduler=self.scheduler, n_live=len(live_ids),
+                decode_rounds=n,
+                decode_tokens=len(live_ids) * (g_iter + 1) * n,
+                decode_rows=int(live_g.shape[0]),
+                compaction_ratio=(len(live_ids)
+                                  / max(int(live_g.shape[0]), 1)))
+            self._stage_spec_stats(g_iter, len(live_ids), st=stats)
+            if self.trace_recorder is not None:
+                self._stage_decode_spans(live_ids, n, out=spans)
+            plan = _Plan(
+                kind="decode", sel=[], activating=[], n_rounds=n,
+                win=g_iter + 1, g_iter=g_iter, spec_lens=spec_lens,
+                live_ids=live_ids, sl_d=sl_d, live_g=live_g,
+                d_lens=d_lens, d_tables=d_tables, d_last=d_last,
+                d_stop=d_stop, samp_d=samp_d, gid_d=gid_d, aid_d=aid_d,
+                owners=[self._slots[int(s)] for s in live_ids],
+                pf=None, scatter_prompt=False,
+                use_rows_p=False, use_bias_p=False,
+                use_rows_d=bool(
+                    (self._needs_rows & planned_active).any()),
+                use_bias_d=bool(
+                    (self._has_bias & planned_active).any()),
+                use_grammar=bool(
+                    ((self._gid > 0) & planned_active).any()),
+                use_lora=bool(((self._aid > 0) & planned_active).any()),
+                stats=stats, spans=spans)
+        # stage the launch-stable inputs onto the device NOW, inside
+        # the overlap window: jnp.asarray is an async host->device
+        # feed (DD2 deliberately never flags those), so these
+        # transfers ride behind the in-flight program and the
+        # serialized launch tail pays only the (rows,)-sized patched
+        # arrays. jnp.asarray on an already-device array is a no-op,
+        # so _launch_plan's conversion sites serve both paths.
+        if plan.pf is not None:
+            pf = plan.pf
+            for k in ("chunk", "widths", "g_lens", "g_tables",
+                      "sample_at", "slot_ids", "prompt_rows",
+                      "prompt_lens", "orig_lens", "countm", "scatm",
+                      "gid_g", "gst0_g", "aid_g"):
+                pf[k] = jnp.asarray(pf[k])
+            pf["samp_g"] = jax.tree.map(jnp.asarray, pf["samp_g"])
+        plan.d_stop = jnp.asarray(plan.d_stop)
+        plan.samp_d = jax.tree.map(jnp.asarray, plan.samp_d)
+        plan.gid_d = jnp.asarray(plan.gid_d)
+        plan.aid_d = jnp.asarray(plan.aid_d)
+        if prof is not None:
+            prof.mark("build")
+        return plan
+
+    def _launch_plan(self, plan: "_Plan") -> None:
+        """Patch the plan's data-dependent decode inputs from the
+        just-committed ledger, then launch it ASYNCHRONOUSLY — no
+        device_get here; the sync is the next step's
+        `_commit_inflight`. The patch is the whole serialized cost of
+        re-anchoring the plan: a (rows,) re-gather of lengths / last
+        tokens / table rows plus deadening rows whose slot died at the
+        commit (their sentinel tables drop every device write, and
+        `owners` masks their host commit)."""
+        prof = self._profiler
+        live_ids = plan.live_ids
+        nl = len(live_ids)
+        if nl and plan.n_rounds > 0:
+            if plan.sl_d is None:
+                # rows ARE slots: the ledger views are the patched
+                # arrays (dead slots already carry sentinel tables and
+                # active=False from _release_slot)
+                plan.live_g = self.active.copy()
+                plan.d_lens = self.lengths
+                plan.d_tables = self.tables
+                plan.d_last = self.last_token
+            else:
+                for i in range(nl):
+                    sid = int(live_ids[i])
+                    alive = (self._slots[sid] is plan.owners[i]
+                             and self.active[sid])
+                    plan.live_g[i] = alive
+                    plan.d_lens[i] = self.lengths[sid]
+                    plan.d_last[i] = self.last_token[sid]
+                    plan.d_tables[i] = self.tables[sid]
+            if plan.kind == "decode" and not plan.live_g[:nl].any():
+                # every planned row died at the commit: nothing left
+                # to dispatch — drain the pipeline instead of paying a
+                # fully-inert program
+                return
+        # analysis: allow[lock-discipline] atomically-swapped
+        # reference, rebuilt under _lock pre-admission
+        grammar = self._grammar_dev if plan.use_grammar else None
+        lora = self.adapters.device_args() if plan.use_lora else None
+        sl_dev = None if plan.sl_d is None else jnp.asarray(plan.sl_d)
+        lim_dev = (None if plan.spec_lens is None else jnp.asarray(
+            self._pad_limits(plan.spec_lens, int(plan.live_g.shape[0]))))
+        if plan.kind == "mixed":
+            pf = plan.pf
+            self.state, ptoks, plps, lens, last, (toks, lps, counts) = \
+                _mixed_step(
+                    self.params, self.state, jnp.asarray(pf["chunk"]),
+                    jnp.asarray(pf["widths"]),
+                    jnp.asarray(pf["g_lens"]),
+                    jnp.asarray(pf["g_tables"]),
+                    jnp.asarray(pf["sample_at"]),
+                    jnp.asarray(pf["slot_ids"]),
+                    jnp.asarray(pf["prompt_rows"]),
+                    jnp.asarray(pf["prompt_lens"]),
+                    jax.tree.map(jnp.asarray, pf["samp_g"]),
+                    jnp.asarray(pf["orig_lens"]),
+                    jnp.asarray(pf["countm"]),
+                    jnp.asarray(pf["scatm"]), jnp.asarray(pf["gid_g"]),
+                    jnp.asarray(pf["gst0_g"]),
+                    jnp.asarray(plan.d_lens),
+                    jnp.asarray(plan.d_tables),
+                    jnp.asarray(plan.d_last), jnp.asarray(plan.live_g),
+                    jnp.asarray(plan.d_stop),
+                    jax.tree.map(jnp.asarray, plan.samp_d),
+                    jnp.asarray(plan.gid_d), sl_dev, lim_dev,
+                    self._next_rng(), grammar, lora,
+                    jnp.asarray(pf["aid_g"]), jnp.asarray(plan.aid_d),
+                    self.draft_params,
+                    cfg=self.cfg, infer_cfg=self.infer_cfg,
+                    n_rounds=plan.n_rounds, n_drafts=plan.g_iter,
+                    scatter_prompt=plan.scatter_prompt, mesh=self.mesh,
+                    draft_cfg=self.draft_cfg,
+                    use_rows_p=plan.use_rows_p,
+                    use_bias_p=plan.use_bias_p,
+                    use_rows_d=plan.use_rows_d,
+                    use_bias_d=plan.use_bias_d)
+            futures = (ptoks, plps, toks, lps, counts, lens, last)
+        else:
+            args = (jnp.asarray(plan.d_lens),
+                    jnp.asarray(plan.d_tables),
+                    jnp.asarray(plan.d_last), jnp.asarray(plan.live_g))
+            samp = jax.tree.map(jnp.asarray, plan.samp_d)
+            gid = jnp.asarray(plan.gid_d)
+            aid = jnp.asarray(plan.aid_d)
+            if plan.g_iter > 0:
+                self.state, lens, last, (toks, lps, counts) = \
+                    _spec_rounds(
+                        self.params, self.state, *args,
+                        jnp.asarray(plan.d_stop), self._next_rng(),
+                        samp, gid, grammar, lora, aid,
+                        self.draft_params, sl_dev, lim_dev,
+                        cfg=self.cfg, infer_cfg=self.infer_cfg,
+                        n_rounds=plan.n_rounds, n_drafts=plan.g_iter,
+                        mesh=self.mesh, draft_cfg=self.draft_cfg,
+                        use_rows=plan.use_rows_d,
+                        use_bias=plan.use_bias_d)
+            else:
+                self.state, lens, last, (toks, lps, counts) = \
+                    _decode_rounds(
+                        self.params, self.state, *args,
+                        self._next_rng(), samp, gid, grammar, lora,
+                        aid, sl_dev,
+                        cfg=self.cfg, infer_cfg=self.infer_cfg,
+                        n_rounds=plan.n_rounds, mesh=self.mesh,
+                        use_rows=plan.use_rows_d,
+                        use_bias=plan.use_bias_d)
+            futures = (toks, lps, counts, lens, last)
+        t = (prof.mark("launch") if prof is not None
+             else time.perf_counter())
+        self._iter_launch_ts = t
+        self._inflight = _Inflight(
+            kind=plan.kind, futures=futures, sel=plan.sel,
+            activating=plan.activating, live_ids=live_ids,
+            owners=plan.owners, n_rounds=plan.n_rounds, win=plan.win,
+            g_iter=plan.g_iter, spec_lens=plan.spec_lens,
+            stats=plan.stats, spans=plan.spans, t_launch=t)
+
+    def _commit_inflight(self) -> None:
+        """Sync and commit the in-flight dispatch: THE serialized
+        critical path of the async scheduler. One device_get brings
+        the sampled tokens home; the ledger writes, token emits,
+        activations, speculation feedback, and deferred sweep reaps
+        all run on the synced values — guarded per row by the owners
+        identity captured at plan time (a whole step ran since the
+        launch)."""
+        infl, self._inflight = self._inflight, None
+        t_wait = time.perf_counter()
+        # analysis: allow[lock-discipline] THE sanctioned per-iteration
+        # host sync — one launched dispatch, one device_get, under the
+        # step lock that serializes the scheduler by design
+        vals = jax.device_get(infl.futures)
+        prof = self._profiler
+        if prof is not None:
+            prof.mark("device")
+        st = infl.stats
+        st["overlap"] = True
+        st["inflight_depth"] = 1
+        # how long the device ran ahead of the host needing results:
+        # launch -> the moment this step's overlapped work finished
+        # and the sync began. Residual device phase > 0 means the
+        # device was still busy through the whole overlap window.
+        st["overlap_launch_lead_ms"] = (t_wait - infl.t_launch) * 1e3
+        # install BEFORE the commit work below: _commit_decode_rows
+        # appends its spec-token fields to self._iter_stats, and they
+        # belong to THIS record
+        self._iter_stats = st
+        self._iter_spans = infl.spans
+        n_rounds, g_iter = infl.n_rounds, infl.g_iter
+        if infl.kind == "mixed":
+            ptoks, plps, toks, lps, counts, lens, last = vals
+        else:
+            toks, lps, counts, lens, last = vals
+            if g_iter == 0:
+                toks = np.asarray(toks)[:, :, None]
+                lps = np.asarray(lps)[:, :, None]
+        if n_rounds > 0:
+            if (g_iter == 0 and self.spec_drafts > 0
+                    and self.spec_control is not None):
+                self.spec_control.on_plain_dispatch(
+                    [int(s) for s in infl.live_ids], n_rounds)
+            self._commit_decode_rows(
+                infl.live_ids, np.asarray(toks), np.asarray(lps),
+                counts, lens, last,
+                self._drafted_rows(g_iter, infl.spec_lens,
+                                   len(infl.live_ids)),
+                owners=infl.owners)
+        if infl.kind == "mixed":
+            self._complete_admission_chunks(infl.sel, ptoks, plps)
+        self._apply_reaps()
+        if prof is not None:
+            prof.mark("commit")
+
+    def _overlap_sweep(self) -> None:
+        """Sweep for an overlapped step: cancelled / deadline-expired
+        SLOT holders are only MARKED (active=False + queued on
+        _reaped) — the in-flight dispatch is still writing their
+        pages, and releasing mid-flight could hand a page to a new
+        admission while the device writes it. `_apply_reaps` releases
+        them right after the commit, in this same step. Pending-queue
+        expiry is pure host state and runs exactly like the
+        sequential sweep."""
+        job_slots = {s for job in self._jobs for s in job.slots}
+        marked = {sid for sid, _, _ in self._reaped}
+        now = None
+        for sid, slot in enumerate(self._slots):
+            if slot is None or sid in job_slots or sid in marked:
+                continue
+            if slot.req._cancel.is_set():
+                self.active[sid] = False
+                self._reaped.append((sid, slot, "cancelled"))
+                continue
+            if slot.req.deadline is not None:
+                if now is None:
+                    now = time.perf_counter()
+                if now > slot.req.deadline:
+                    self.active[sid] = False
+                    self._reaped.append((sid, slot, "deadline"))
+        self._expire_pending(now)
+
+    def _apply_reaps(self) -> None:
+        """Deferred-release half of `_overlap_sweep`, run just after
+        the commit: the marked slots' pages are fully committed KV
+        now, so they release through the normal content-keyed path
+        (reusable in the prefix cache) and the requests complete."""
+        if not self._reaped:
+            return
+        reaped, self._reaped = self._reaped, []
+        for sid, slot, reason in reaped:
+            if self._slots[sid] is not slot:
+                continue  # already torn down (failure path)
+            s = self._release_slot(sid, self._committed(sid))
+            s.req.finish_reason = reason
+            self._complete(s.req)
+
+    def _step_overlap(self) -> int:
+        """One pipelined scheduler iteration (overlap on). With a
+        dispatch in flight: plan iteration N+1 (sweep marks, QoS/DRR
+        admission, the whole numpy build) WHILE the device runs
+        iteration N, then sync+commit N, patch, and launch N+1 — one
+        fused dispatch and one device_get per step, with only the
+        commit/patch/launch tail serialized against the device.
+        With nothing in flight (cold start, post-drain, famine): run
+        the byte-identical sequential iteration, then PRIME the
+        pipeline by planning and launching the next dispatch before
+        returning."""
+        with self._step_lock:
+            self.tracer.step_start()
+            prof = self._profiler
+            try:
+                if self._faults is not None:
+                    self._faults.maybe_stall()
+                    self._faults.maybe_wedge(self._stop)
+                if prof is not None:
+                    prof.begin()
+                al = self.allocator
+                al.telemetry.iteration = self.flight.iterations + 1
+                c0 = (al.pages_allocated, al.pages_released,
+                      al.evictions)
+                if self._inflight is None:
+                    # pipeline fill: the sequential iteration, plus a
+                    # launch-ahead prime so the NEXT step overlaps
+                    self._sweep_cancelled()
+                    if prof is not None:
+                        prof.mark("sweep")
+                    self._start_admissions()
+                    if prof is not None:
+                        prof.mark("admission")
+                    self._iter_stats = {}
+                    p0 = self.preemptions
+                    t0 = (prof.t0 if prof is not None
+                          else time.perf_counter())
+                    if self._jobs:
+                        self._mixed_dispatch()
+                    elif self.active.any():
+                        self._decode_dispatch()
+                    if self._jobs or self.active.any():
+                        plan = self._plan_iteration()
+                        if plan is not None:
+                            self._launch_plan(plan)
+                    self._record_iteration(t0, p0, c0)
+                    if self._iter_stats:
+                        self.last_busy_ts = self._iter_stats["ts"]
+                    else:
+                        self.idle_iterations += 1
+                    return self.num_active
+                # steady state: one commit + one launch per step
+                self._overlap_sweep()
+                if prof is not None:
+                    prof.mark("sweep")
+                self._start_admissions()
+                if prof is not None:
+                    prof.mark("admission")
+                p0 = self.preemptions
+                t0 = (prof.t0 if prof is not None
+                      else time.perf_counter())
+                if self._faults is not None:
+                    # injected dispatch failure: ONE hit per step
+                    # (the fill path's site lives inside its
+                    # sequential dispatch), raised before the
+                    # commit below — serve_forever catches,
+                    # _fail_all drops the in-flight futures and
+                    # unblocks every waiter
+                    self._faults.check("dispatch")
+                plan = self._plan_iteration()
+                self._commit_inflight()
+                if plan is not None:
+                    self._launch_plan(plan)
+                self._record_iteration(t0, p0, c0)
+                self.last_busy_ts = self._iter_stats["ts"]
+                return self.num_active
+            finally:
+                self.tracer.step_end()
 
     # -- scheduler ----------------------------------------------------------
 
@@ -2591,6 +3343,14 @@ class PagedInferenceServer:
                     slot = self._release_slot(sid, self._committed(sid))
                     slot.req.finish_reason = "deadline"
                     self._complete(slot.req)
+        self._expire_pending(now)
+
+    def _expire_pending(self, now: float | None) -> None:
+        """Reap deadline-expired PENDING requests (pure host-queue
+        state — safe whether or not a dispatch is in flight, so both
+        the sequential and the overlap sweep share it). The expiry
+        clock stays lazy: zero reads when nothing pending carries a
+        deadline."""
         with self._lock:
             expired = []
             if any(r.deadline is not None for r in self._pending):
@@ -2625,7 +3385,14 @@ class PagedInferenceServer:
         so a busy flight record's `duration_ms` covers the WHOLE
         iteration and equals `host_ms + device_wait_ms` exactly.
         Disabled, the historical two-read clock (dispatch start →
-        epilogue) is byte-identical."""
+        epilogue) is byte-identical.
+
+        With the async double-buffered scheduler enabled (overlap on,
+        mixed scheduler — the default) the iteration is PIPELINED:
+        see `_step_overlap`. overlap=False keeps the sequential body
+        below byte-identical to the pre-overlap build."""
+        if self._overlap_enabled:
+            return self._step_overlap()
         with self._step_lock:
             self.tracer.step_start()
             prof = self._profiler
@@ -2672,14 +3439,18 @@ class PagedInferenceServer:
             finally:
                 self.tracer.step_end()
 
-    def _stage_decode_spans(self, live_ids, n_rounds: int) -> None:
+    def _stage_decode_spans(self, live_ids, n_rounds: int,
+                            out: list | None = None) -> None:
         """Stage one decode_segment span per traced live slot for this
         iteration's decode dispatch (stamped with the shared iteration
-        frame by _record_iteration)."""
+        frame by _record_iteration). `out` overrides the destination
+        (a launch-ahead plan's staged spans)."""
+        if out is None:
+            out = self._iter_spans
         for sid in live_ids:
             s = self._slots[int(sid)]
             if s is not None and s.req.trace is not None:
-                self._iter_spans.append(
+                out.append(
                     (s.req, "decode_segment",
                      {"slot": int(sid), "rounds": n_rounds}))
 
@@ -2749,13 +3520,32 @@ class PagedInferenceServer:
             st["t_start"] = t0
             st["phases_ms"] = phases
             st["duration_ms"] = (now - t0) * 1e3
-            st.update(derive_gap_fields(phases, st["duration_ms"]))
+            overlapped = bool(st.get("overlap"))
+            st.update(derive_gap_fields(phases, st["duration_ms"],
+                                        overlapped))
             hists = self._phase_hists
-            for p, v in phases.items():
-                hists[p].observe(v)
+            if overlapped:
+                # sweep/admission/build ran under the in-flight
+                # device program: fold them into the `overlap` series
+                # so the histogram-derived host-gap stays honest (the
+                # fine split survives in this flight record)
+                hists["overlap"].observe(
+                    sum(phases.get(p, 0.0) for p in OVERLAP_PHASES))
+                for p, v in phases.items():
+                    if p not in OVERLAP_PHASES:
+                        hists[p].observe(v)
+            else:
+                for p, v in phases.items():
+                    hists[p].observe(v)
         else:
             now = time.perf_counter()
             st["duration_ms"] = (now - t0) * 1e3
+        if self._iter_launch_ts is not None:
+            # the launch-ahead performed THIS step (the Perfetto
+            # inflight track pairs it with the NEXT record's residual
+            # device wait)
+            st["t_launch"] = self._iter_launch_ts
+            self._iter_launch_ts = None
         if self._brownout is not None:
             # overload grading over signals this record already owns;
             # the pending head's age is the queue-growth signal (one
@@ -3032,6 +3822,17 @@ class PagedInferenceServer:
             "eviction_matrix": tel.eviction_matrix(),
         }
 
+    def overlap_stats(self) -> dict:
+        """The /stats `overlap` block: the async scheduler's resolved
+        knob state and the live pipeline depth. Scrape path only."""
+        return {
+            "enabled": self.overlap,
+            "active": self._overlap_enabled,
+            # analysis: allow[lock-discipline] racy-by-design
+            # monitoring read; staleness bounded by one iteration
+            "inflight_depth": 0 if self._inflight is None else 1,
+        }
+
     def brownout_stats(self) -> dict | None:
         """The /stats `brownout` block (level, signal EWMAs vs
         thresholds, per-class shed counts); None with brownout
@@ -3122,6 +3923,12 @@ class PagedInferenceServer:
                     slot.req.finish_reason = f"error: {exc!r}"
                     self._complete(slot.req)
             self._jobs.clear()
+            # async scheduler: drop the launched-but-uncommitted
+            # dispatch's futures (its results belong to requests that
+            # just failed; like the wedged-teardown case, any still-
+            # running device work finishes into buffers nothing reads)
+            self._inflight = None
+            self._reaped.clear()
         finally:
             if got:
                 self._step_lock.release()
@@ -3131,7 +3938,7 @@ class PagedInferenceServer:
             req.finish_reason = f"error: {exc!r}"
             self._complete(req)
 
-    def serve_forever(self, idle_sleep_s: float = 0.002) -> None:
+    def serve_forever(self, idle_sleep_s: float = 0.05) -> None:
         while not self._stop.is_set():
             try:
                 busy = self.step()
@@ -3141,10 +3948,29 @@ class PagedInferenceServer:
                 self._fail_all(exc)
                 self._stop.set()
                 return
+            # cooperative yield after every busy step: the sequential
+            # loop's blocking device_get released the GIL for a whole
+            # device step each iteration, guaranteeing stream-consumer
+            # threads (SSE writers, result() waiters) a drain window;
+            # the pipelined loop's syncs can return instantly, so
+            # without an explicit yield a fast scheduler can emit a
+            # whole request before a streaming client's writer thread
+            # runs once — delaying disconnect detection to the end
+            if busy:
+                time.sleep(0)
             # analysis: allow[lock-discipline] idle-polling read on the
             # scheduler's own thread — the only _jobs writer
             if busy == 0 and self.num_pending == 0 and not self._jobs:
-                self._stop.wait(idle_sleep_s)
+                # bounded CONDITION wait, not a short sleep poll: an
+                # idle fleet must not spin step() hundreds of times a
+                # second (the idle_iterations_total growth-rate
+                # regression test pins this). submit() notifies _work,
+                # so admission latency never pays the timeout; the
+                # timeout itself keeps pending-deadline sweeps and
+                # stop() responsive even if a notify is missed.
+                with self._work:
+                    if not self._pending and not self._stop.is_set():
+                        self._work.wait(idle_sleep_s)
 
     def start(self) -> "PagedInferenceServer":
         self._stop.clear()
@@ -3208,6 +4034,10 @@ class PagedInferenceServer:
             # must be rejected, not accepted-then-failed by _fail_all
             self.drain(timeout, _resume_on_timeout=False)
         self._stop.set()
+        with self._lock:
+            # wake a scheduler thread parked on the idle condition
+            # wait so shutdown does not pay the wait timeout
+            self._work.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None

@@ -53,6 +53,7 @@ from jax import lax
 
 from cloud_server_tpu.config import InferConfig, ModelConfig
 from cloud_server_tpu.inference import engine
+from cloud_server_tpu.inference.iteration_profile import OVERLAP_PHASES
 from cloud_server_tpu.inference.sampling import (
     SamplingParams, SamplingRows, make_rows, sample_logits,
     sample_logits_rows, set_rows, zero_rows)
@@ -651,7 +652,8 @@ class InferenceServer:
                  prefix_remainder_cap: int = 1024,
                  metrics: ServingMetrics | None = None,
                  qos=None, tracing=None, slo=None,
-                 iteration_profile=None, faults=None):
+                 iteration_profile=None, faults=None,
+                 overlap: bool | None = None):
         # Serving never needs f32 master weights: pre-cast float32 leaves to
         # the compute dtype once, instead of streaming 2x the bytes and
         # converting on every decode step. QTensor leaves stay quantized
@@ -791,6 +793,29 @@ class InferenceServer:
         self._slots: list[Request | None] = [None] * max_slots
         self._pending: collections.deque[Request] = collections.deque()
         self._lock = threading.Lock()
+        # submit notifies this condition (same mutex as _lock) so an
+        # idle serve_forever parks in a bounded wait instead of
+        # busy-polling (see the paged server's twin)
+        self._work = threading.Condition(self._lock)
+        # Async launch-ahead decode (`InferConfig.overlap` / overlap=,
+        # default on): the decode chunk launched at the END of a step
+        # commits at the START of the next one, so the sweep, the
+        # admission burst (its own prefill dispatch included), and the
+        # step epilogue all run while the device decodes. The launch
+        # always happens AFTER the commit against the fully-committed
+        # ledger — the contiguous server's simpler shape of the paged
+        # server's double-buffered scheduler (no planned frame, no
+        # patching). overlap=False keeps the sequential loop
+        # byte-identical.
+        ov = infer_cfg.overlap if overlap is None else bool(overlap)
+        self.overlap = bool(ov)
+        self._overlap_enabled = self.overlap
+        # (decode output futures, _slots snapshot at launch) — the
+        # snapshot identity-guards the commit: a slot freed and
+        # re-admitted while the chunk was in flight must not receive
+        # the old occupant's tokens
+        self._inflight: tuple | None = None
+        self._iter_overlapped = False  # scheduler-thread scratch
         # Serialises whole scheduler iterations: step() mutates self.state
         # through buffer-donating jits, so two concurrent step() calls
         # (e.g. run_until_idle() on an already start()ed server) would hand
@@ -901,6 +926,9 @@ class InferenceServer:
             req.record_event("submit", req.submit_time)
             self.metrics.observe_submit(req)
             self._pending.append(req)
+            # wake an idle scheduler thread parked on the bounded
+            # condition wait (serve_forever)
+            self._work.notify()
         return req
 
     def _handle_cancel(self, req: Request) -> None:
@@ -1248,16 +1276,29 @@ class InferenceServer:
                 if prof is not None:
                     prof.begin()
                 self._iter_busy = False
+                self._iter_overlapped = False
                 n_active = self._step_locked()
                 if self._iter_busy:
                     if prof is not None:
                         # epilogue = the post-commit tail of the step;
                         # phases feed the rolling histograms (the
-                        # contiguous server's only phase sink)
+                        # contiguous server's only phase sink). An
+                        # overlapped step's sweep/admission/build ran
+                        # under the in-flight decode — fold them into
+                        # the `overlap` series (see iteration_profile)
                         prof.mark("epilogue")
                         hists = self._phase_hists
-                        for p, v in prof.phases_ms().items():
-                            hists[p].observe(v)
+                        phases = prof.phases_ms()
+                        if self._iter_overlapped:
+                            hists["overlap"].observe(
+                                sum(phases.get(p, 0.0)
+                                    for p in OVERLAP_PHASES))
+                            for p, v in phases.items():
+                                if p not in OVERLAP_PHASES:
+                                    hists[p].observe(v)
+                        else:
+                            for p, v in phases.items():
+                                hists[p].observe(v)
                     self.last_busy_ts = time.time()
                 else:
                     self.idle_iterations += 1
@@ -1266,6 +1307,8 @@ class InferenceServer:
                 self.tracer.step_end()
 
     def _step_locked(self) -> int:
+        if self._overlap_enabled:
+            return self._step_locked_overlap()
         prof = self._profiler
         self._sweep_cancelled()
         if prof is not None:
@@ -1315,9 +1358,124 @@ class InferenceServer:
             prof.mark("commit")
         return self.num_active
 
+    def _launch_decode(self, use_rows: bool, use_bias: bool):
+        """Launch one decode chunk asynchronously (no device_get) —
+        the ONE dispatch site the overlap steady-state launch and the
+        pipeline-fill prime share, so a signature change can never
+        desync them. The round count comes from `_chunk_len` HERE
+        (the audited pow2 planner — DD4's boundedness requires the
+        static `n_steps` to be derived inside the dispatching
+        function, not passed through an unbounded parameter). Returns
+        the output futures."""
+        n = self._chunk_len()
+        if n == 1:
+            self.state, out = _decode(
+                self.params, self.state, self._next_rng(),
+                cfg=self.cfg, infer_cfg=self.infer_cfg,
+                use_rows=use_rows, use_bias=use_bias)
+        else:
+            self.state, out = _decode_chunk(
+                self.params, self.state, self._next_rng(),
+                cfg=self.cfg, infer_cfg=self.infer_cfg, n_steps=n,
+                use_rows=use_rows, use_bias=use_bias)
+        return out
+
+    def _commit_decode_chunk(self, out, slots, prof) -> None:
+        """Sync one decode chunk and emit its tokens against `slots`
+        (a _slots snapshot for a launch-ahead commit, the live list on
+        the pipeline-fill path) with the per-row identity guard — THE
+        one commit-emit block both overlap paths share, and the
+        sanctioned per-iteration host sync of the pipelined
+        contiguous loop (dispatch-discipline DD2)."""
+        toks, lps = jax.device_get(out)
+        if prof is not None:
+            prof.mark("device")
+        chunk, lchunk = np.asarray(toks), np.asarray(lps)
+        if chunk.ndim == 1:
+            chunk, lchunk = chunk[None], lchunk[None]
+        for t in range(chunk.shape[0]):
+            for slot, req in enumerate(slots):
+                if req is not None and self._slots[slot] is req \
+                        and self._emit(req, int(chunk[t, slot]),
+                                       float(lchunk[t, slot])):
+                    self._finish(slot, req)
+        if prof is not None:
+            prof.mark("commit")
+
+    def _step_locked_overlap(self) -> int:
+        """Pipelined iteration (overlap on): commit the decode chunk
+        launched at the END of the previous step, then launch the next
+        chunk and return with it in flight — the sweep, the admission
+        burst (its own prefill dispatch and sanctioned sync included),
+        and the next step's epilogue all run while the device decodes.
+
+        Unlike the paged server's planner, nothing here reads stale
+        state: the launch always follows the commit, so the chunk
+        length and the slot snapshot see the fully-committed ledger.
+        The snapshot identity-guards the commit (a slot freed by the
+        sweep and re-admitted mid-flight must not receive the old
+        occupant's tokens; its device row is overwritten by the
+        admission program, which chains after the in-flight decode).
+        With nothing in flight (cold start / post-idle) the step runs
+        the sequential dispatch-sync-commit, then PRIMES the pipeline
+        with a launch-ahead before returning — so per-step emission
+        counts match the sequential loop exactly."""
+        prof = self._profiler
+        self._sweep_cancelled()
+        if prof is not None:
+            prof.mark("sweep")
+        self._admit_pending()
+        if prof is not None:
+            # close the admission window HERE: with a chunk in flight
+            # the commit's device mark comes next, and an
+            # admission-less scan must not leak into `device` (the
+            # burst's own build/device/commit marks accumulated above)
+            prof.mark("admission")
+        committed = False
+        if self._inflight is not None:
+            self._iter_busy = True
+            self._iter_overlapped = True
+            out, snap = self._inflight
+            self._inflight = None
+            self._commit_decode_chunk(out, snap, prof)
+            committed = True
+        if self.num_active == 0:
+            return 0
+        self._iter_busy = True
+        if self._faults is not None:
+            # injected dispatch failure: raises before any device work
+            # (with a chunk possibly in flight the commit above already
+            # ran, so no synced tokens are ever lost to the injection)
+            self._faults.check("dispatch")
+        use_rows, use_bias = self._rows_mode()
+        if prof is not None:
+            prof.mark("admission")
+        out = self._launch_decode(use_rows, use_bias)
+        if committed:
+            # steady state: leave the chunk in flight (launch-ahead)
+            self._inflight = (out, list(self._slots))
+            if prof is not None:
+                prof.mark("launch")
+            return self.num_active
+        # pipeline fill: sequential commit of the chunk just launched
+        self._commit_decode_chunk(out, list(self._slots), prof)
+        if self.num_active:
+            # prime: the next chunk overlaps the NEXT step's host work
+            # (its injected-fault site is the NEXT step's check — one
+            # check per step, matching the sequential hit pacing)
+            use_rows, use_bias = self._rows_mode()
+            out = self._launch_decode(use_rows, use_bias)
+            self._inflight = (out, list(self._slots))
+            if prof is not None:
+                prof.mark("launch")
+        return self.num_active
+
     def _fail_all(self, exc: BaseException) -> None:
         """Unblock every in-flight and pending request after a fatal
         scheduler error (otherwise result() waiters hang forever)."""
+        # drop any launched-but-uncommitted decode chunk's futures:
+        # their tokens belong to requests failed below
+        self._inflight = None
         with self._lock:
             pending, self._pending = list(self._pending), collections.deque()
         for slot, req in enumerate(self._slots):
@@ -1418,6 +1576,15 @@ class InferenceServer:
         `faults` block); None with no FaultPlan. Scrape path only."""
         return None if self._faults is None else self._faults.stats()
 
+    def overlap_stats(self) -> dict:
+        """The /stats `overlap` block (see the paged server's twin):
+        launch-ahead decode pipelining state. Scrape path only."""
+        return {
+            "enabled": self.overlap,
+            "active": self._overlap_enabled,
+            "inflight_depth": 0 if self._inflight is None else 1,
+        }
+
     def request_trace(self, n_steps: int,
                       logdir: str | os.PathLike) -> None:
         """Arm the /debug/trace capture: the next `n_steps` scheduler
@@ -1430,7 +1597,7 @@ class InferenceServer:
 
     # -- background serving -------------------------------------------------
 
-    def serve_forever(self, idle_sleep_s: float = 0.002) -> None:
+    def serve_forever(self, idle_sleep_s: float = 0.05) -> None:
         while not self._stop.is_set():
             try:
                 busy = self.step()
@@ -1440,8 +1607,19 @@ class InferenceServer:
                 self._fail_all(exc)
                 self._stop.set()
                 return
+            # cooperative yield after every busy step (see the paged
+            # server's twin): stream-consumer threads must get a
+            # drain window even when the pipelined syncs return
+            # instantly
+            if busy:
+                time.sleep(0)
             if busy == 0 and self.num_pending == 0:
-                self._stop.wait(idle_sleep_s)
+                # bounded condition wait, not a short sleep poll: idle
+                # CPU iterations stay bounded while submit() wakes the
+                # thread immediately (see the paged server's twin)
+                with self._work:
+                    if not self._pending and not self._stop.is_set():
+                        self._work.wait(idle_sleep_s)
 
     def drain(self, timeout: float | None = None, *,
               _resume_on_timeout: bool = True) -> bool:
@@ -1484,6 +1662,9 @@ class InferenceServer:
             # paged server's stop() for why)
             self.drain(timeout, _resume_on_timeout=False)
         self._stop.set()
+        with self._lock:
+            # wake a scheduler thread parked on the idle wait
+            self._work.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None

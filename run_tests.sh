@@ -43,9 +43,34 @@
 # (the scheduler phase
 # clock: overhead/clock-read guard, flight-record phase split,
 # /debug/scheduler_trace Perfetto export + span cross-links, idle
-# visibility, fleet merge) rides [g-o]. The suite is also runnable
-# standalone:
+# visibility, fleet merge) rides [g-o], and tests/test_overlap.py
+# (the async double-buffered scheduler: overlap-on/off exactness
+# parity, pipeline dispatch discipline, deferred sweep reaps, fault
+# injection with a dispatch in flight, idle-spin bounds) rides [g-o]
+# too. The suite is also runnable standalone:
 #   python -m cloud_server_tpu.analysis [--json] [--checker <id>]
+#
+# Tier-1 budget note (PR 14): the driver's one-process gate
+# (`timeout 870 pytest tests/ -m 'not slow'`) had been TRUNCATING at
+# the budget since ~PR 13 — DOTS_PASSED=318 with the whole
+# alphabetical tail (test_p* onward) never executed, so the gate
+# measured less than the fast set claims. PR 14 re-balanced by
+# marking the ~300 s of heaviest REDUNDANT e2e tests slow (see the
+# PR-14 block at the end of tests/slow_tests.txt: profiler-capture
+# smokes, duplicate speculation-parity e2es whose exactness twins
+# remain fast, debug-endpoint round-trips — NOT
+# test_paged_server_tp_sharded_matches_single_device, which stays
+# fast as the sole sharded-paged-serving parity check now that the
+# async scheduler defaults on). Measured baseline after the
+# re-balance on the reference sandbox:
+#   one-process fast set: 744 s wall / 711 s pytest, DOTS_PASSED=547
+#   — a COMPLETE run back under the 870 s budget with ~125 s headroom
+#   for box-load variance (vs 318 truncated dots before; a first
+#   re-balance at 788 s/557 dots was observed to graze the budget on
+#   a slower run, hence the extra ~90 s of demotions).
+# If the gate starts truncating again (RC=124, DOTS below the
+# baseline), move the newest heavy non-essential tests to
+# slow_tests.txt rather than letting the tail silently drop.
 MARK=(-m "not slow")
 if [ "$1" = "--all" ]; then
     MARK=(); shift

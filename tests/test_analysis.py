@@ -547,6 +547,55 @@ def test_dispatch_rosters_cover_both_servers():
         assert rel in dispatch.HOST_POLICY_MODULES
 
 
+def test_dispatch_overlap_plan_release_free():
+    """DD5: the async scheduler's plan path must not reach a
+    page-releasing function — directly, or transitively through a
+    same-class helper — while a dispatch may be in flight."""
+    src = (
+        "class S:\n"
+        "    def _release_slot(self, sid):\n"
+        "        pass\n"
+        "    def _helper(self):\n"
+        "        self._release_slot(0)\n"
+        "    def _plan_iteration(self):\n"
+        "        self._helper()\n"
+        "    def _launch_plan(self, plan):\n"
+        "        self.allocator.release([1])\n"
+        "    def _overlap_sweep(self):\n"
+        "        self.allocator.alloc(2)\n"
+    )
+    findings = dispatch.check_overlap_source(
+        "s.py", src, ("S._plan_iteration", "S._launch_plan",
+                      "S._overlap_sweep"))
+    msgs = [f.message for f in findings]
+    assert any("_release_slot" in m for m in msgs), msgs  # transitive
+    assert any("allocator.release" in m for m in msgs), msgs  # direct
+    assert all("DD5" in m for m in msgs)
+    # alloc on the plan path is fine; the clean function is silent
+    assert not [f for f in findings if f.symbol == "S._overlap_sweep"]
+
+
+def test_dispatch_overlap_missing_plan_function_is_a_finding():
+    findings = dispatch.check_overlap_source(
+        "s.py", "class S:\n    pass\n", ("S._plan_iteration",))
+    assert findings and "not found" in findings[0].message
+
+
+def test_dispatch_overlap_roster_covers_the_async_scheduler():
+    rel = "cloud_server_tpu/inference/paged_server.py"
+    assert rel in dispatch.OVERLAP_PLAN_FUNCS
+    quals = dispatch.OVERLAP_PLAN_FUNCS[rel]
+    for want in ("PagedInferenceServer._plan_iteration",
+                 "PagedInferenceServer._launch_plan",
+                 "PagedInferenceServer._overlap_sweep",
+                 "PagedInferenceServer._extend_chains_planned"):
+        assert want in quals
+    # the launch-ahead commit is a sanctioned sync, like every other
+    # per-iteration commit point
+    assert ("PagedInferenceServer._commit_inflight"
+            in dispatch.SANCTIONED_SYNCS[rel])
+
+
 # -- reporters / CLI --------------------------------------------------------
 
 def test_json_report_shape_is_stable():

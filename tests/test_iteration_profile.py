@@ -127,20 +127,29 @@ def test_flight_records_carry_phase_split(params):
         assert set(phases) <= set(PHASES)
         assert all(v >= 0.0 for v in phases.values())
         # the acceptance identity: the phase split PARTITIONS the
-        # iteration — host + device-wait reassemble duration exactly
-        assert rec["host_ms"] + rec["device_wait_ms"] == pytest.approx(
+        # iteration — host + device-wait (+ overlapped host work on
+        # async-scheduler iterations) reassemble duration exactly
+        assert (rec["host_ms"] + rec["device_wait_ms"]
+                + rec.get("overlap_ms", 0.0)) == pytest.approx(
             rec["duration_ms"], rel=1e-9, abs=1e-6)
         assert 0.0 <= rec["host_gap_frac"] <= 1.0
         assert rec["t_start"] > 0.0
         # a busy mixed iteration crossed every boundary
         assert "device" in phases and "epilogue" in phases
+    # the default scheduler pipelines: the steady-state records are
+    # overlapped and carry the async fields
+    ov = [rec for rec in window if rec.get("overlap")]
+    assert ov, "default mixed churn produced no overlapped iterations"
+    for rec in ov:
+        assert rec["inflight_depth"] == 1
+        assert rec["overlap_launch_lead_ms"] >= 0.0
     # per-phase histograms observed once per busy iteration
     snap = srv.metrics_snapshot()
     dev = snap['cloud_server_iter_phase_ms{phase="device"}']
     assert dev["type"] == "histogram"
     assert dev["count"] == srv.flight.iterations
     summary = srv.iteration_profile_stats()
-    assert set(summary["phases"]) <= set(PHASES)
+    assert set(summary["phases"]) <= set(PHASES) | {"overlap"}
     assert 0.0 <= summary["host_gap_frac"] <= 1.0
 
 
@@ -187,11 +196,16 @@ def test_contiguous_server_feeds_phase_histograms(params):
 
 def test_profiled_mixed_step_dispatch_sync_and_clock_counts(
         params, monkeypatch):
-    """The profiling-enabled clone of the `_mixed_step` dispatch/
-    device_get-count regression test, plus the profiler's own budget:
-    phase stamping performs a bounded CONSTANT number of perf_counter
-    reads per mixed iteration (begin + one mark per boundary — the
-    count must not scale with slots, jobs, or tokens)."""
+    """The profiling-enabled clone of the dispatch/device_get-count
+    regression test, plus the profiler's own budget: phase stamping
+    performs a bounded CONSTANT number of perf_counter reads per
+    pipelined iteration (begin + one mark per boundary — the count
+    must not scale with slots, jobs, or tokens).
+
+    Under the async scheduler a steady-state step issues exactly ONE
+    fused dispatch — `_mixed_step` while the planned frame has prefill
+    work, else the decode/spec program — and ONE device_get (the
+    previous launch's commit)."""
     from cloud_server_tpu.inference import paged_server as ps
     srv = PagedInferenceServer(params, CFG, GREEDY, scheduler="mixed",
                                iteration_profile=True, **PAGED_KW)
@@ -199,14 +213,17 @@ def test_profiled_mixed_step_dispatch_sync_and_clock_counts(
     srv.step()
     assert srv.num_active == 1
 
-    calls = {"mixed": 0, "get": 0, "clock": 0}
-    orig_mixed = ps._mixed_step
+    calls = {"dispatch": 0, "get": 0, "clock": 0}
+    origs = {n: getattr(ps, n) for n in
+             ("_mixed_step", "_decode_rounds", "_spec_rounds")}
     orig_get = jax.device_get
     orig_clock = ip.perf_counter
 
-    def mixed_wrap(*a, **k):
-        calls["mixed"] += 1
-        return orig_mixed(*a, **k)
+    def wrap(name):
+        def w(*a, **k):
+            calls["dispatch"] += 1
+            return origs[name](*a, **k)
+        return w
 
     def get_wrap(x):
         calls["get"] += 1
@@ -216,7 +233,8 @@ def test_profiled_mixed_step_dispatch_sync_and_clock_counts(
         calls["clock"] += 1
         return orig_clock()
 
-    monkeypatch.setattr(ps, "_mixed_step", mixed_wrap)
+    for n in origs:
+        monkeypatch.setattr(ps, n, wrap(n))
     monkeypatch.setattr(jax, "device_get", get_wrap)
     # counts ONLY the profiler's reads: the module binds perf_counter
     # as a module global, so every begin/mark goes through this
@@ -230,20 +248,21 @@ def test_profiled_mixed_step_dispatch_sync_and_clock_counts(
         before = dict(calls)
         srv.step()
         churn_steps += 1
-        assert calls["mixed"] - before["mixed"] == 1, \
-            "profiled mixed iteration must stay ONE fused dispatch"
+        assert calls["dispatch"] - before["dispatch"] == 1, \
+            "profiled pipelined iteration must stay ONE fused dispatch"
         assert calls["get"] - before["get"] == 1, \
-            "profiled mixed iteration must stay ONE host sync"
+            "profiled pipelined iteration must stay ONE host sync"
         clock_per_step.add(calls["clock"] - before["clock"])
         assert churn_steps < 50
     assert churn_steps >= 2  # real churn: admission spanned iterations
     # bounded constant: begin + sweep + admission(step) +
-    # admission(dispatch) + build + device + commit + epilogue = 8
+    # admission(plan) + build + device + commit + launch + epilogue = 9
     assert len(clock_per_step) == 1, (
         f"profiler clock reads varied across mixed iterations: "
         f"{clock_per_step}")
-    assert clock_per_step.pop() <= 8
-    monkeypatch.setattr(ps, "_mixed_step", orig_mixed)
+    assert clock_per_step.pop() <= 9
+    for n, f in origs.items():
+        monkeypatch.setattr(ps, n, f)
     monkeypatch.setattr(jax, "device_get", orig_get)
     monkeypatch.setattr(ip, "perf_counter", orig_clock)
     srv.run_until_idle()
@@ -307,8 +326,10 @@ def test_scheduler_chrome_trace_wellformed(params):
     xs = [e for e in events if e["ph"] == "X"]
     metas = [e for e in events if e["ph"] == "M"]
     assert metas, "process/thread name metadata missing"
+    inflight_tid = len(PHASES) + 1
     iters = [e for e in xs if e["tid"] == 0]
-    phases = [e for e in xs if e["tid"] > 0]
+    phases = [e for e in xs if 0 < e["tid"] < inflight_tid]
+    inflight = [e for e in xs if e["tid"] == inflight_tid]
     assert len(iters) == len(window)
     # iteration indices agree with flight_window()
     assert [e["args"]["iteration"] for e in iters] == \
@@ -325,6 +346,22 @@ def test_scheduler_chrome_trace_wellformed(params):
     want = sum(len([v for v in rec["phases_ms"].values() if v > 0])
                for rec in window)
     assert len(phases) == want
+    # async-scheduler round trip: overlapped iterations render their
+    # committed dispatch as a CONCURRENT inflight slice — launched
+    # inside the PREVIOUS record's window, ending at this record's
+    # residual device wait — so the slice must START before its
+    # committing iteration begins and OVERLAP that iteration's bounds
+    # (the old export wrongly assumed disjoint iteration windows)
+    assert inflight, "overlapped run rendered no inflight slices"
+    for e in inflight:
+        it = by_iter[e["args"]["iteration"]]
+        assert e["ts"] < it["ts"]                      # launched earlier
+        assert e["ts"] + e["dur"] > it["ts"]           # spans into it
+        assert e["ts"] + e["dur"] <= it["ts"] + it["dur"] + 1.0
+        launched_in = e["args"]["launched_in_iteration"]
+        prev = by_iter.get(launched_in)
+        if prev is not None:  # still in the retained window
+            assert prev["ts"] <= e["ts"] <= prev["ts"] + prev["dur"] + 1.0
 
 
 def test_scheduler_trace_skips_unprofiled_records(params):

@@ -144,6 +144,15 @@ def test_disconnect_aborts_streaming_request(params):
     """A streaming client that vanishes mid-generation must free its
     slot long before max_tokens; the server keeps serving others.
 
+    Drives the NATIVE /generate endpoint: it writes one ndjson line
+    per token even without a tokenizer, so the writer thread can
+    observe the peer close mid-generation (the OpenAI SSE stream with
+    no tokenizer emits no per-token bytes — a disconnect there is
+    only detectable at end-of-stream, and the old test built on it
+    passed vacuously by racing ahead of admission). The wait loop
+    first waits for the request to actually START, so the abort
+    assertions can never be satisfied by a not-yet-admitted request.
+
     Gated on the net_compat loopback probe: in sandboxes whose
     loopback stack never surfaces a peer close as a send error, the
     front-end cannot observe the disconnect (verified identical at the
@@ -159,15 +168,21 @@ def test_disconnect_aborts_streaming_request(params):
     front = HttpFrontend(srv).start()
     try:
         host, port = front.address
-        body = json.dumps({"prompt": PROMPT, "max_tokens": 200,
-                           "stream": True}).encode()
-        raw = (f"POST /v1/completions HTTP/1.1\r\nHost: {host}\r\n"
+        body = json.dumps({"tokens": PROMPT,
+                           "max_new_tokens": 200}).encode()
+        raw = (f"POST /generate HTTP/1.1\r\nHost: {host}\r\n"
                f"Content-Length: {len(body)}\r\n\r\n").encode() + body
         s = socket.create_connection((host, port), timeout=30)
         s.sendall(raw)
-        s.recv(1024)  # first SSE bytes: generation is streaming
+        s.recv(1024)  # first streamed bytes: generation is running
         s.close()     # client walks away
         deadline = time.time() + 60
+        # non-vacuous: the request must really be in flight first
+        while time.time() < deadline and srv.tokens_emitted == 0 \
+                and srv.num_active == 0 and not srv._jobs:
+            time.sleep(0.01)
+        assert srv.num_active or srv._jobs or srv.tokens_emitted, \
+            "request never started"
         while time.time() < deadline:
             if srv.num_active == 0 and not srv._jobs:
                 break

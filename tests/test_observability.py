@@ -317,7 +317,13 @@ def test_mixed_step_dispatch_and_sync_count(params, monkeypatch,
     multi-tenant registry live, so the per-tenant CACHE attribution
     path (cache_telemetry record hooks inside every allocator
     lookup/alloc/release) is pinned to zero added dispatches/syncs
-    too."""
+    too.
+
+    Under the (default) async scheduler a steady-state step issues
+    exactly ONE fused dispatch — `_mixed_step` while the planned frame
+    has prefill work, else the decode/spec program on the
+    kind-transition step — and ONE device_get (the previous launch's
+    commit), so the counter wraps all three dispatch entry points."""
     from cloud_server_tpu.inference import paged_server as ps
     srv = PagedInferenceServer(params, CFG, GREEDY, scheduler="mixed",
                                **PAGED_KW, **extra_kw)
@@ -325,19 +331,25 @@ def test_mixed_step_dispatch_and_sync_count(params, monkeypatch,
     srv.step()  # warm decode running before the long prompt lands
     assert srv.num_active == 1
 
-    calls = {"mixed": 0, "get": 0}
-    orig_mixed = ps._mixed_step
+    calls = {"dispatch": 0, "mixed": 0, "get": 0}
+    origs = {n: getattr(ps, n) for n in
+             ("_mixed_step", "_decode_rounds", "_spec_rounds")}
     orig_get = jax.device_get
 
-    def mixed_wrap(*a, **k):
-        calls["mixed"] += 1
-        return orig_mixed(*a, **k)
+    def wrap(name):
+        def w(*a, **k):
+            calls["dispatch"] += 1
+            if name == "_mixed_step":
+                calls["mixed"] += 1
+            return origs[name](*a, **k)
+        return w
 
     def get_wrap(x):
         calls["get"] += 1
         return orig_get(x)
 
-    monkeypatch.setattr(ps, "_mixed_step", mixed_wrap)
+    for n in origs:
+        monkeypatch.setattr(ps, n, wrap(n))
     monkeypatch.setattr(jax, "device_get", get_wrap)
 
     long = srv.submit([(k * 7) % 60 + 1 for k in range(40)],
@@ -347,15 +359,18 @@ def test_mixed_step_dispatch_and_sync_count(params, monkeypatch,
         before = dict(calls)
         srv.step()
         churn_steps += 1
-        assert calls["mixed"] - before["mixed"] == 1, \
+        assert calls["dispatch"] - before["dispatch"] == 1, \
             "mixed iteration must stay ONE fused dispatch"
         assert calls["get"] - before["get"] == 1, \
             "mixed iteration must stay ONE host sync"
         assert churn_steps < 50
     # 40-token remainder over 16-token chunks: admission spans >1 fused
-    # iteration, so the invariant was tested under real churn
+    # iteration, so the invariant was tested under real churn — and
+    # the fused program really carried the prefill half
     assert churn_steps >= 2
-    monkeypatch.setattr(ps, "_mixed_step", orig_mixed)
+    assert calls["mixed"] >= 2
+    for n, f in origs.items():
+        monkeypatch.setattr(ps, n, f)
     monkeypatch.setattr(jax, "device_get", orig_get)
     srv.run_until_idle()
     assert warm.done and long.done

@@ -483,19 +483,28 @@ def test_mixed_step_dispatch_count_with_qos(params, monkeypatch):
     srv.step()
     assert srv.num_active == 1
 
-    calls = {"mixed": 0, "get": 0}
-    orig_mixed = ps._mixed_step
+    # the (default) async scheduler dispatches _mixed_step while the
+    # planned frame has prefill work and the decode/spec program on
+    # kind-transition steps — ONE fused dispatch either way
+    calls = {"dispatch": 0, "mixed": 0, "get": 0}
+    origs = {n: getattr(ps, n) for n in
+             ("_mixed_step", "_decode_rounds", "_spec_rounds")}
     orig_get = jax.device_get
 
-    def mixed_wrap(*a, **k):
-        calls["mixed"] += 1
-        return orig_mixed(*a, **k)
+    def wrap(name):
+        def w(*a, **k):
+            calls["dispatch"] += 1
+            if name == "_mixed_step":
+                calls["mixed"] += 1
+            return origs[name](*a, **k)
+        return w
 
     def get_wrap(x):
         calls["get"] += 1
         return orig_get(x)
 
-    monkeypatch.setattr(ps, "_mixed_step", mixed_wrap)
+    for n in origs:
+        monkeypatch.setattr(ps, n, wrap(n))
     monkeypatch.setattr(jax, "device_get", get_wrap)
 
     srv.submit([(k * 7) % 60 + 1 for k in range(40)],
@@ -507,13 +516,15 @@ def test_mixed_step_dispatch_count_with_qos(params, monkeypatch):
         before = dict(calls)
         srv.step()
         churn_steps += 1
-        assert calls["mixed"] - before["mixed"] == 1, \
+        assert calls["dispatch"] - before["dispatch"] == 1, \
             "QoS must not add dispatches to the mixed iteration"
         assert calls["get"] - before["get"] == 1, \
             "QoS must not add host syncs to the mixed iteration"
         assert churn_steps < 60
     assert churn_steps >= 2
-    monkeypatch.setattr(ps, "_mixed_step", orig_mixed)
+    assert calls["mixed"] >= 2
+    for n, f in origs.items():
+        monkeypatch.setattr(ps, n, f)
     monkeypatch.setattr(jax, "device_get", orig_get)
     srv.run_until_idle()
     assert warm.done
