@@ -404,11 +404,16 @@ def _fault_recovery_bench(params, base, infer_cfg):
         replica-0 breaker open (placement stops routing there);
       * `fault_recovery_retry_success_rate` — zero-token failed
         requests resubmitted to replica 1 that completed normally
-        (the safe-retry rule; partially-streamed requests fail fast
-        by design and land in completed_frac instead);
-      * `fault_recovery_{baseline,injected}_completed_frac` and
-        `..._slo_ttft` — the client-visible blast radius vs the
-        uninjected control at identical load.
+        (the safe-retry rule);
+      * `fault_recovery_migration_success_rate`, `..._migration_ms_p50`
+        and `..._tokens_salvaged_frac` — the mid-stream kills: requests
+        that had already streamed tokens are LIVE-MIGRATED (host state
+        salvaged, resumed token-exact on replica 1) instead of failing
+        fast; salvaged-frac is the share of the migrated requests'
+        decode budget carried over rather than regenerated;
+      * `fault_recovery_{baseline,injected}_completed_frac`,
+        `..._slo_ttft` and `..._slo_itl` — the client-visible blast
+        radius vs the uninjected control at identical load.
 
     Both arms run twice (untimed compile warm-up, then measured),
     like the churn benches."""
@@ -423,7 +428,8 @@ def _fault_recovery_bench(params, base, infer_cfg):
     cfg = dataclasses.replace(base, decode_attention_impl="pallas")
     slo_cfg = {"windows_s": [300],
                "classes": {"default": {"objective": 0.99, "ttft_s": 5.0,
-                                       "e2e_s": 600.0}}}
+                                       "itl_s": 2.0, "e2e_s": 600.0}}}
+    max_new = 96
 
     def scenario(inject: bool):
         fp = FaultPlan() if inject else None
@@ -440,7 +446,8 @@ def _fault_recovery_bench(params, base, infer_cfg):
         rng = np.random.RandomState(0)
         reqs = [router.submit([int(x) for x in
                                rng.randint(1, 30000, size=64)],
-                              max_new_tokens=96) for _ in range(16)]
+                              max_new_tokens=max_new)
+                for _ in range(16)]
         for _ in range(4):
             router.step()
         t_fault = t_open = None
@@ -458,11 +465,16 @@ def _fault_recovery_bench(params, base, infer_cfg):
                  if r.done
                  and not (r.finish_reason or "").startswith("error"))
         rep = router.slo_report()
-        att = (rep["classes"]["default"]["metrics"]
-               .get("ttft", {}).get("lifetime", {}).get("attainment"))
+        mets = rep["classes"]["default"]["metrics"]
+
+        def attainment(name):
+            a = mets.get(name, {}).get("lifetime", {}).get("attainment")
+            return 1.0 if a is None else a
+
         snap = router.metrics_snapshot()
         res = {"completed_frac": ok / len(reqs),
-               "slo_ttft": 1.0 if att is None else att}
+               "slo_ttft": attainment("ttft"),
+               "slo_itl": attainment("itl")}
         if inject:
             res["time_to_breaker_open_ms"] = (
                 -1.0 if t_open is None else (t_open - t_fault) * 1e3)
@@ -471,6 +483,19 @@ def _fault_recovery_bench(params, base, infer_cfg):
                 "value"]
             res["retries"] = retries
             res["retry_success_rate"] = succ / max(retries, 1)
+            # the mid-stream half of the kill: live migrations
+            from cloud_server_tpu.utils.serving_metrics import \
+                histogram_percentile
+            mig = router.migration_stats()
+            hist = snap.get("cloud_server_migration_ms")
+            res["migrations"] = mig["out_started"]
+            res["migration_success_rate"] = mig["success_rate"]
+            res["migration_ms_p50"] = (
+                histogram_percentile(hist, 0.50)
+                if hist and hist.get("count") else -1.0)
+            res["tokens_salvaged_frac"] = (
+                mig["tokens_salvaged"]
+                / max(mig["in_completed"] * max_new, 1))
         for r in reqs:
             r.cancel()
         router.run_until_idle()
@@ -484,18 +509,30 @@ def _fault_recovery_bench(params, base, infer_cfg):
         out[f"fault_recovery_{tag}_completed_frac"] = \
             res["completed_frac"]
         out[f"fault_recovery_{tag}_slo_ttft"] = res["slo_ttft"]
+        out[f"fault_recovery_{tag}_slo_itl"] = res["slo_itl"]
         if inject:
             out["fault_recovery_time_to_breaker_open_ms"] = \
                 res["time_to_breaker_open_ms"]
             out["fault_recovery_retries"] = res["retries"]
             out["fault_recovery_retry_success_rate"] = \
                 res["retry_success_rate"]
+            out["fault_recovery_migrations"] = res["migrations"]
+            out["fault_recovery_migration_success_rate"] = \
+                res["migration_success_rate"]
+            out["fault_recovery_migration_ms_p50"] = \
+                res["migration_ms_p50"]
+            out["fault_recovery_tokens_salvaged_frac"] = \
+                res["tokens_salvaged_frac"]
         print(f"[serving_bench] fault_recovery_{tag}: completed "
               f"{res['completed_frac']:.2f}, slo_ttft "
-              f"{res['slo_ttft']:.3f}"
+              f"{res['slo_ttft']:.3f}, slo_itl {res['slo_itl']:.3f}"
               + (f", breaker open in "
                  f"{res['time_to_breaker_open_ms']:.1f} ms, retry "
-                 f"success {res['retry_success_rate']:.2f}"
+                 f"success {res['retry_success_rate']:.2f}, "
+                 f"{res['migrations']} migrations (success "
+                 f"{res['migration_success_rate']:.2f}, p50 "
+                 f"{res['migration_ms_p50']:.1f} ms, salvaged "
+                 f"{res['tokens_salvaged_frac']:.2f})"
                  if inject else ""), flush=True)
     return out
 

@@ -109,6 +109,19 @@ SCHEDULER_LOOPS: dict[str, tuple[str, ...]] = {
         "PagedInferenceServer._release_slot",
         "PagedInferenceServer._committed",
         "PagedInferenceServer._next_rng",
+        # live-migration path: off the step loop (it runs on router /
+        # drain threads), but policed by the same sync discipline — the
+        # export owns its ONE sanctioned device_get (below), and the
+        # import must stay async (its scatter is a dispatch; jnp.asarray
+        # feeds are the input path DD2 deliberately allows)
+        "PagedInferenceServer.migrate_export",
+        "PagedInferenceServer.migrate_salvage",
+        "PagedInferenceServer._export_request_locked",
+        "PagedInferenceServer._build_snapshot",
+        "PagedInferenceServer._evacuate_request_locked",
+        "PagedInferenceServer._evacuate",
+        "PagedInferenceServer.migrate_import",
+        "PagedInferenceServer._import_pages",
     ),
     "cloud_server_tpu/inference/server.py": (
         "InferenceServer.step",
@@ -147,6 +160,11 @@ SANCTIONED_SYNCS: dict[str, tuple[str, ...]] = {
         # itself must stay sync-free (DD2 covers it like every other
         # loop function)
         "PagedInferenceServer._commit_inflight",
+        # live migration: the request export's KV gather — ONE sync per
+        # migration, at the commit point (inflight work committed
+        # first), under the step lock and off the plan path, so DD5's
+        # overlap window never sees it
+        "PagedInferenceServer._export_request_locked",
     ),
     "cloud_server_tpu/inference/server.py": (
         "InferenceServer._admit_group",
@@ -190,6 +208,7 @@ PLAN_BOUNDED_FIELDS = frozenset({"n_rounds", "g_iter"})
 HOST_POLICY_MODULES: tuple[str, ...] = (
     "cloud_server_tpu/inference/qos.py",
     "cloud_server_tpu/inference/faults.py",
+    "cloud_server_tpu/inference/migration.py",
     "cloud_server_tpu/inference/slo.py",
     "cloud_server_tpu/inference/request_trace.py",
     "cloud_server_tpu/inference/spec_control.py",

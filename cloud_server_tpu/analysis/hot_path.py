@@ -143,6 +143,26 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "ReplicatedRouter.speculation_stats",
         "ReplicatedRouter.cache_stats",
     ),
+    # live migration: the ledger's record hooks run on the export /
+    # import paths while the SOURCE or DESTINATION server's step lock
+    # is held (a stall there freezes that replica's scheduler), and
+    # drain_flight_deltas runs once per busy iteration inside
+    # _record_iteration to feed the flight recorder's migrated_in/out
+    # counts. The snapshot helpers run under the same locks. The
+    # device-touching export/import bodies live in paged_server (and
+    # are covered by the dispatch-discipline pass), NOT here — this
+    # module must stay pure host bookkeeping.
+    "cloud_server_tpu/inference/migration.py": (
+        "MigrationLedger.record_export_start",
+        "MigrationLedger.record_export_done",
+        "MigrationLedger.record_export_failed",
+        "MigrationLedger.record_import_start",
+        "MigrationLedger.record_import_done",
+        "MigrationLedger.record_import_failed",
+        "MigrationLedger.drain_flight_deltas",
+        "MigrationSnapshot.remaining_new_tokens",
+        "MigrationSnapshot.n_kv_pages",
+    ),
     "cloud_server_tpu/inference/qos.py": (
         "TokenBucket._refill",
         "TokenBucket.level",

@@ -75,6 +75,7 @@ LOCK_ROSTER: tuple[str, ...] = (
     "cloud_server_tpu/inference/paged_server.py",
     "cloud_server_tpu/inference/qos.py",
     "cloud_server_tpu/inference/faults.py",
+    "cloud_server_tpu/inference/migration.py",
     "cloud_server_tpu/inference/router.py",
     "cloud_server_tpu/inference/request_trace.py",
     "cloud_server_tpu/inference/slo.py",

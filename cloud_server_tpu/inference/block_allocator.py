@@ -244,6 +244,66 @@ class BlockAllocator:
             self.telemetry.record_alloc(tenant, hits)  # refs held
         return shared, hits * ps
 
+    def import_chain(self, tokens: list[int], namespace: str = "",
+                     tenant: str | None = None) -> list[tuple[int, int]]:
+        """Key a migrated chain's full pages into the cache so a
+        continuation admission re-hits them.
+
+        Walks the chain keys for every full page of `tokens` (the
+        migration snapshot's committed stream). A key already cached
+        DEDUPES — the destination holds identical content, nothing to
+        transfer for that position. A miss claims a page (evicting LRU
+        cached pages like alloc) and keys it; the caller must scatter
+        the snapshot's KV into every returned page BEFORE any lookup
+        can hit it (the scheduler holds its step lock across
+        import + scatter, and admissions only run inside the step).
+
+        Returns [(chain_index, page_id)] for the pages this call
+        created — the positions whose device KV the caller must fill.
+        Capacity shortage stops the walk early: a partial import is a
+        valid (shorter) cached prefix, just a smaller prefill saving.
+        Created pages land at refcount 0, cached and evictable —
+        exactly the state a released chain leaves behind.
+        """
+        ps = self.page_size
+        self._namespaces.add(namespace)
+        parent = _root_for(namespace)
+        fill: list[tuple[int, int]] = []
+        created: list[int] = []
+        for i in range(len(tokens) // ps):
+            key = (parent, tuple(tokens[i * ps:(i + 1) * ps]))
+            page = self._cache.get(key)
+            if page is not None:
+                parent = _chain_digest(*key)
+                continue
+            if self.available < 1:
+                break
+            if not self._free:
+                self._evict_one(forcer=tenant)
+            page = self._free.popleft()
+            # refcount 1 for the duration of the walk: eviction only
+            # touches refcount-0 pages, so later iterations of THIS
+            # import can never reclaim an earlier created page
+            self._ref[page] = 1
+            self._owner[page] = tenant
+            self.pages_allocated += 1
+            self._cache[key] = page
+            self._key_of[page] = key
+            parent = _chain_digest(*key)
+            self._depth[page] = i + 1
+            self._digest[page] = parent
+            created.append(page)
+            fill.append((i, page))
+        if created:
+            self.telemetry.record_alloc(tenant, len(created))
+            for page in created:
+                self._ref[page] = 0
+                self.pages_released += 1
+                self._evictable[page] = None
+                self._idle_since[page] = self.telemetry.iteration
+            self.telemetry.record_release(tenant, len(created))
+        return fill
+
     # -- release ------------------------------------------------------------
 
     def release(self, pages: list[int], tokens: list[int],

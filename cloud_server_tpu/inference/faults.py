@@ -44,6 +44,16 @@ unconfigured server runs the byte-identical pre-fault code):
                            is empty (paged only): exercises the
                            famine-retry / preemption paths without
                            shrinking the pool.
+  * ``migrate_export``   — the next migration export raises
+                           `InjectedFault` before snapshotting (paged
+                           only): exercises the non-migratable
+                           fallback (the request fails fast with
+                           today's `retriable: false` body).
+  * ``migrate_import``   — the next migration import raises
+                           `InjectedFault` on the destination (paged
+                           only): exercises the router's
+                           import-failure path (failure stands on the
+                           original handle).
 
 Plans are SEEDED: a spec may fire probabilistically (``p < 1``) and
 the draw sequence comes from one `random.Random(seed)`, so a given
@@ -106,7 +116,7 @@ from cloud_server_tpu.inference.server import QueueFullError
 # only; membership is validated at spec construction so a typo'd site
 # fails the plan parse, not silently never-fires.
 SITES = ("submit_reject", "dispatch", "iteration_stall", "wedge",
-         "alloc_famine")
+         "alloc_famine", "migrate_export", "migrate_import")
 
 
 class InjectedFault(RuntimeError):

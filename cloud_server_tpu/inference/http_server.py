@@ -129,13 +129,19 @@ Fault tolerance (docs/serving.md "Fault tolerance"): an
 scheduler cancels it once passed; finish_reason "deadline"); overload
 brownout and tenant rate limits both surface as 429s with the
 structured `Retry-After` body (brownout hints carry seeded jitter so
-shed clients do not thundering-herd the recovery). A request that
-FAILS mid-stream ends its stream with `{"error", "retriable"}` —
-`retriable: false` once any token was streamed (resubmitting would
-duplicate output; the router's zero-token failover already exhausted
-every safe retry), and non-streaming 503s carry `retriable: true`.
-Behind a ReplicatedRouter, `/healthz` gains a `replicas` list with
-per-replica circuit-breaker state.
+shed clients do not thundering-herd the recovery). Behind a
+ReplicatedRouter, a request that fails mid-stream is LIVE-MIGRATED
+(inference/migration.py): the router salvages its generated state and
+resumes it on a healthy replica at the exact next token, on the SAME
+stream — the client sees one contiguous token sequence and never
+learns a replica died. Only when migration cannot proceed (export
+fault, no healthy replica, past deadline) does the stream end with
+`{"error", "retriable"}` — `retriable: false` once any token was
+streamed (resubmitting from scratch would duplicate delivered output;
+the router already exhausted every safe retry AND every migration
+path), and non-streaming 503s carry `retriable: true`. Behind a
+ReplicatedRouter, `/healthz` gains a `replicas` list with per-replica
+circuit-breaker state and `/stats` a fleet-merged `migration` block.
 
 Multi-tenant QoS (inference/qos.py): when the backend carries a
 TenantRegistry, each request's tenant comes from an API key
@@ -690,6 +696,14 @@ class HttpFrontend:
             fstats = ffn()
             if fstats is not None:
                 payload["faults"] = fstats
+        # live-migration counters (inference/migration.py): behind the
+        # router this is the fleet merge with success_rate recomputed
+        # from the merged totals; a single server reports its ledger
+        mfn = getattr(self.srv, "migration_stats", None)
+        if mfn is not None:
+            mstats = mfn()
+            if mstats is not None:
+                payload["migration"] = mstats
         # router breaker view (behind a ReplicatedRouter)
         brfn = getattr(self.srv, "breaker_states", None)
         if brfn is not None:
@@ -801,9 +815,12 @@ class HttpFrontend:
         """Structured terminal error for a STREAMING response whose
         request failed: `{"error", "retriable"}`. retriable is False
         once any token was streamed — the client must not resubmit or
-        it may receive duplicated output (the router's safe-retry rule
-        already exhausted every zero-token recovery before this
-        surfaces). None when the request did not fail."""
+        it may receive duplicated output. Behind a ReplicatedRouter
+        this surfaces only for NON-MIGRATABLE failures: the router
+        first exhausts every zero-token retry AND every live-migration
+        path (inference/migration.py — a migrated request continues on
+        the same stream and never reaches here). None when the request
+        did not fail."""
         reason = request.finish_reason or ""
         if not reason.startswith("error"):
             return None
@@ -895,9 +912,10 @@ class HttpFrontend:
             err = self._error_line(request)
             if err is not None:
                 # structured terminal error: a partially-streamed
-                # request fails fast with retriable: false (resending
-                # would duplicate the streamed tokens); zero-token
-                # failures are safe to resubmit
+                # request that could NOT be live-migrated ends with
+                # retriable: false (resending would duplicate the
+                # streamed tokens); zero-token failures are safe to
+                # resubmit
                 handler.wfile.write((json.dumps(err) + "\n").encode())
             else:
                 handler.wfile.write((json.dumps(

@@ -1272,6 +1272,13 @@ class PagedInferenceServer:
             raise ValueError(
                 "brownout needs a QoS registry: shed sets are priority "
                 "classes, and without tenants nothing can be shed")
+        # live request migration (inference/migration.py): the ledger
+        # is always present — its record hooks are int adds under a
+        # leaf lock, and the migration counter families must exist
+        # (zeros) for the docs drift check whether or not a migration
+        # ever runs
+        from cloud_server_tpu.inference.migration import MigrationLedger
+        self._migration = MigrationLedger()
         # _fail_all teardown accounting: how many times the bounded
         # _step_lock acquire timed out and teardown proceeded
         # UNSERIALIZED against a wedged scheduler (the
@@ -1362,7 +1369,7 @@ class PagedInferenceServer:
                tenant: str | None = None,
                trace_ctx: tuple | None = None,
                deadline_s: float | None = None,
-               fail_handler=None) -> Request:
+               fail_handler=None, _migration=None) -> Request:
         if self._stop.is_set():
             raise RuntimeError("server is stopped; not accepting requests")
         if self._faults is not None:
@@ -1399,8 +1406,11 @@ class PagedInferenceServer:
             self._grammar_gid(sampling.regex)  # compile now; 400 here
         if self.qos is not None:
             tenant = self.qos.resolve(tenant)
-            if self._brownout is not None:
+            if self._brownout is not None and _migration is None:
                 # overload brownout: shed this class's admissions with
+                # (migration continuations are exempt: the stream's
+                # tokens are already paid for and delivered — shedding
+                # one loses strictly more work than it saves)
                 # a jittered Retry-After (429) while the detector
                 # grades the replica overloaded — interactive traffic
                 # keeps its SLO instead of every class degrading
@@ -1421,9 +1431,24 @@ class PagedInferenceServer:
         req = Request(prompt=list(prompt), max_new_tokens=max_new,
                       stream=stream, sampling=sampling, adapter=adapter,
                       tenant=tenant,
-                      seed_used=resolve_seed(sampling, self._host_rng,
-                                             self._lock),
+                      seed_used=(_migration.seed_used
+                                 if _migration is not None else
+                                 resolve_seed(sampling, self._host_rng,
+                                              self._lock)),
                       submit_time=time.perf_counter())
+        if _migration is not None:
+            # migration continuation (inference/migration.py): resume
+            # another replica's stream. The generated state is filled
+            # in BEFORE the append below makes the request visible to
+            # the scheduler, which then admits it as a CONTINUATION
+            # (admission prompt = prompt + tokens, the preemption-
+            # resume path) and decode picks up at the exact next
+            # token. seed_used above is the SOURCE's seed: RNG streams
+            # are position-keyed, so seed + token index reproduces
+            # every future draw exactly — no generator state crosses.
+            req.tokens = list(_migration.tokens)
+            req.logprobs = list(_migration.logprobs)
+            req.emit_times = list(_migration.emit_times)
         if deadline_s is None and self.qos is not None:
             # per-QoS-class default deadline (None when the tenant's
             # class declares none)
@@ -3510,6 +3535,14 @@ class PagedInferenceServer:
         h = self._cache_hists.get("evictable_frac")
         if h is not None:
             h.observe(frac)
+        # live-migration flow (deltas accrued since the last busy
+        # record): requests resumed here / evacuated from here — only
+        # present on records that saw one, so unmigrated records stay
+        # byte-identical
+        mig_in, mig_out = self._migration.drain_flight_deltas()
+        if mig_in or mig_out:
+            st["migrated_in"] = mig_in
+            st["migrated_out"] = mig_out
         prof = self._profiler
         if prof is not None:
             # everything since the commit mark (the stats assembly
@@ -3653,6 +3686,24 @@ class PagedInferenceServer:
                         labels={"class": cls}).set_total(
                             0 if bstats is None
                             else bstats["shed_total"].get(cls, 0))
+        # live-migration counters (inference/migration.py): same
+        # unconditional-registration rule as the fault families —
+        # export and import halves each count one operation
+        mstats = self._migration.stats()
+        reg.counter("migrations_started_total",
+                    "Live-migration operations started (request "
+                    "exports + imports; inference/migration.py)"
+                    ).set_total(mstats["started"])
+        reg.counter("migrations_completed_total",
+                    "Live-migration operations completed (the "
+                    "request left this replica with its state, or "
+                    "resumed here at the exact next token)"
+                    ).set_total(mstats["completed"])
+        reg.counter("migrations_failed_total",
+                    "Live-migration operations that failed — the "
+                    "request fell back to fail-fast "
+                    "(`retriable: false`) or to the normal drain "
+                    "wait").set_total(mstats["failed"])
         stats = self.allocator.stats()
         reg.gauge("pages_total",
                   "KV page pool size").set(stats.pages_total)
@@ -3844,6 +3895,14 @@ class PagedInferenceServer:
         `faults` block); None with no FaultPlan. Scrape path only."""
         return None if self._faults is None else self._faults.stats()
 
+    def migration_stats(self) -> dict:
+        """Live-migration counters (the /stats `migration` block):
+        export/import starts, completions, failures, tokens salvaged,
+        KV pages moved. Counts are fleet-mergeable —
+        `ReplicatedRouter.migration_stats()` sums them and recomputes
+        the success rate from the merged totals. Scrape path only."""
+        return self._migration.stats()
+
     @property
     def ready(self) -> bool:
         """Readiness (vs the liveness /healthz always reported): False
@@ -3889,6 +3948,322 @@ class PagedInferenceServer:
         # GIL-atomic list; step() below observes the exact state
         while self.num_pending or self.num_active or self._jobs:
             self.step()
+
+    # -- live migration -----------------------------------------------------
+
+    def migrate_export(self, req: Request, *, reason: str = "failover",
+                       evacuate: bool = True):
+        """Snapshot one live (slot or pending) request for migration
+        to another replica (inference/migration.py).
+
+        Runs at the scheduler's sanctioned commit point: under
+        `_step_lock`, with any in-flight dispatch committed first, so
+        the host token stream, the KV watermark, and the grammar
+        position are exact. The chain's committed full pages ride
+        along via the export's one sanctioned `device_get` (off the
+        plan path, so DD5 holds — see analysis/dispatch.py's
+        sanctioned-sync inventory).
+
+        With `evacuate=True` (default) the request leaves this server
+        atomically with the snapshot: its slot releases through the
+        normal content-keyed path (the committed KV stays reusable in
+        the local prefix cache) and NOBODY completes the handle — the
+        caller re-admits the snapshot elsewhere and mirrors the
+        outcome back. A request mid-admission (chunked prefill still
+        dispatching) is not exportable and raises RuntimeError; the
+        caller lets it finish or fail normally."""
+        led = self._migration
+        led.record_export_start()
+        try:
+            if self._faults is not None:
+                self._faults.check("migrate_export")
+            with self._step_lock:
+                if self._inflight is not None:
+                    # drain the pipeline first: the in-flight
+                    # dispatch's tokens belong to the stream being
+                    # exported
+                    self._commit_inflight()
+                snap, sid, committed = self._export_request_locked(
+                    req, reason)
+                if evacuate:
+                    self._evacuate_request_locked(req, sid, committed)
+        except BaseException:
+            led.record_export_failed()
+            raise
+        led.record_export_done(len(snap.tokens), snap.n_kv_pages())
+        return snap
+
+    def migrate_salvage(self, req: Request, *,
+                        reason: str = "failover"):
+        """Crash-path export: a host-only snapshot (no KV — a failed
+        scheduler's `_fail_all` already released its pages unkeyed)
+        built from the Request handle alone. Token exactness does not
+        depend on the pages: the destination re-prefills
+        prompt + tokens and resumes at the exact next token; the KV
+        transfer is only ever a prefill-cost optimization."""
+        led = self._migration
+        led.record_export_start()
+        try:
+            if self._faults is not None:
+                self._faults.check("migrate_export")
+            snap = self._build_snapshot(req, reason, (), None)
+        except BaseException:
+            led.record_export_failed()
+            raise
+        led.record_export_done(len(snap.tokens), 0)
+        return snap
+
+    def _build_snapshot(self, req: Request, reason: str,
+                        chain_tokens, kv: dict | None):
+        from cloud_server_tpu.inference.migration import (
+            MIGRATION_VERSION, MigrationSnapshot)
+        now = time.perf_counter()
+        tr = req.trace
+        return MigrationSnapshot(
+            version=MIGRATION_VERSION, request_id=req.request_id,
+            reason=reason, prompt=tuple(req.prompt),
+            tokens=tuple(req.tokens), logprobs=tuple(req.logprobs),
+            emit_times=tuple(req.emit_times), seed_used=req.seed_used,
+            sampling=req.sampling, adapter=req.adapter,
+            tenant=req.tenant, slo_class=req.slo_class,
+            max_new_tokens=req.max_new_tokens,
+            # the REMAINDER, not the absolute stamp: deadlines are
+            # per-host monotonic clocks and must not cross machines
+            deadline_remaining_s=(None if req.deadline is None
+                                  else req.deadline - now),
+            trace_ctx=(None if tr is None
+                       else (tr.trace_id, tr.root_span_id, True)),
+            chain_tokens=tuple(chain_tokens), kv_pages=kv)
+
+    def _export_request_locked(self, req: Request, reason: str):
+        """Locate `req` (slot or pending) and snapshot it. Caller
+        holds `_step_lock` with no dispatch in flight. Returns
+        (snapshot, slot_id | None, committed_tokens)."""
+        sid = next((i for i, s in enumerate(self._slots)
+                    if s is not None and s.req is req), None)
+        if sid is None:
+            with self._lock:
+                if req not in self._pending:
+                    raise RuntimeError(
+                        "request is not live on this server (already "
+                        "finished, failed, or cancelled)")
+            return self._build_snapshot(req, reason, (), None), None, []
+        if any(sid in job.slots for job in self._jobs):
+            raise RuntimeError(
+                "request is mid-admission (chunked prefill in "
+                "flight); not exportable until prefill completes")
+        committed = self._committed(sid)
+        ps = self.page_size
+        n_full = len(committed) // ps
+        kv = None
+        if n_full:
+            slot = self._slots[sid]
+            ids = np.asarray(slot.pages[:n_full])
+            gathered = {name: pool[:, ids]
+                        for name, pool in self.state["pools"].items()}
+            draft = self.state.get("draft_pools")
+            if draft is not None:
+                for name, pool in draft.items():
+                    gathered["draft/" + name] = pool[:, ids]
+            # analysis: allow[lock-discipline] the migration export's
+            # ONE sanctioned host sync — at the commit point, off the
+            # plan path (DD5), under the step lock that serializes
+            # the scheduler by design (analysis/dispatch.py
+            # SANCTIONED_SYNCS)
+            kv = jax.device_get(gathered)
+        return (self._build_snapshot(req, reason,
+                                     committed[:n_full * ps], kv),
+                sid, committed)
+
+    def _evacuate_request_locked(self, req: Request, sid: int | None,
+                                 committed: list) -> None:
+        """Remove the exported request from this server WITHOUT
+        completing it — the caller now owns the handle's fate. The
+        slot (if any) releases content-keyed, so its committed KV
+        stays reusable in the local prefix cache. The source half of
+        the trace closes here; the destination joins the same tree
+        via the snapshot's trace context."""
+        if sid is not None:
+            if (self._slots[sid] is None
+                    or self._slots[sid].req is not req):
+                raise RuntimeError("slot changed under export")
+            self._release_slot(sid, committed)
+        else:
+            with self._lock:
+                try:
+                    self._pending.remove(req)
+                except ValueError:
+                    raise RuntimeError(
+                        "request left the pending queue during "
+                        "export") from None
+                if self.qos is not None:
+                    self.qos.on_pending_removed(req.tenant)
+        # a `finish:` event so the SOURCE half of the trace closes as
+        # a complete, gap-free tree (build_tree keys the root's end on
+        # the final finish event; the destination's continuation tree
+        # carries the rest of the request under the same trace id)
+        req.record_event("finish:migrated", time.perf_counter())
+        if self.trace_recorder is not None and req.trace is not None:
+            self.trace_recorder.finish(req)
+
+    def migrate_import(self, snap, *, stream=None, fail_handler=None,
+                       trace_ctx: tuple | None = None,
+                       deadline_s: float | None = None) -> Request:
+        """Re-admit a migrated request on THIS server. The snapshot's
+        KV pages are keyed into the pool under their radix chain keys
+        (shared prefixes dedupe on arrival — BlockAllocator.
+        import_chain) and scattered back with a device_put + one
+        dispatch, no host sync (DD2 holds). The request then enters
+        through the NORMAL continuation admission: its admission
+        prompt is prompt + generated tokens, so the prefix walk
+        re-hits the imported pages and decode resumes at the exact
+        next token. A failed or partial KV import degrades to plain
+        re-prefill — a cache miss, never a correctness event.
+
+        Returns the new Request handle. Only NEW tokens are emitted
+        on `stream`; the snapshot's already-delivered tokens are
+        pre-filled so the client keeps one contiguous stream."""
+        from cloud_server_tpu.inference.migration import (
+            MIGRATION_VERSION)
+        led = self._migration
+        led.record_import_start()
+        try:
+            if self._faults is not None:
+                self._faults.check("migrate_import")
+            if snap.version != MIGRATION_VERSION:
+                raise ValueError(
+                    f"migration snapshot version {snap.version} != "
+                    f"{MIGRATION_VERSION}")
+            if snap.remaining_new_tokens() <= 0:
+                raise ValueError(
+                    "snapshot has no decode budget left to resume")
+            if snap.kv_pages:
+                try:
+                    self._import_pages(snap)
+                except Exception:
+                    pass  # re-prefill instead; exactness unaffected
+            if deadline_s is None:
+                deadline_s = snap.deadline_remaining_s
+            req = self.submit(
+                list(snap.prompt),
+                max_new_tokens=snap.max_new_tokens, stream=stream,
+                sampling=snap.sampling, adapter=snap.adapter,
+                tenant=snap.tenant,
+                trace_ctx=(snap.trace_ctx if trace_ctx is None
+                           else trace_ctx),
+                deadline_s=deadline_s, fail_handler=fail_handler,
+                _migration=snap)
+        except BaseException:
+            led.record_import_failed()
+            raise
+        led.record_import_done()
+        return req
+
+    def _import_pages(self, snap) -> int:
+        """Scatter the snapshot's KV pages into the pool under their
+        chain keys. Holds `_step_lock` so the keyed-but-not-yet-
+        written window is invisible: admissions (the only readers)
+        run inside the step, which serializes behind this scatter.
+        Returns the number of pages installed (0 = full dedupe or a
+        skipped transfer)."""
+        tenant = (self.qos.resolve(snap.tenant)
+                  if self.qos is not None else None)
+        # BOUNDED acquire: a migrating drain can run in both
+        # directions at once (A evacuating into B while B evacuates
+        # into A), and each evacuation holds its own step lock while
+        # importing into the other — an unbounded acquire here would
+        # be that ABBA deadlock. Timing out just skips the KV
+        # transfer: the continuation re-prefills (a cache miss).
+        if not self._step_lock.acquire(timeout=5.0):
+            return 0
+        try:
+            fill = self.allocator.import_chain(
+                list(snap.chain_tokens), namespace=snap.adapter or "",
+                tenant=tenant)
+            if not fill:
+                return 0
+            idxs = np.asarray([i for i, _ in fill])
+            ids = np.asarray([p for _, p in fill])
+            pools = self.state["pools"]
+            for name, pool in pools.items():
+                src = snap.kv_pages.get(name)
+                if src is not None:
+                    pools[name] = pool.at[:, ids].set(
+                        jnp.asarray(src[:, idxs]))
+            draft = self.state.get("draft_pools")
+            if draft is not None:
+                for name, pool in draft.items():
+                    src = snap.kv_pages.get("draft/" + name)
+                    if src is not None:
+                        draft[name] = pool.at[:, ids].set(
+                            jnp.asarray(src[:, idxs]))
+            return len(fill)
+        finally:
+            self._step_lock.release()
+
+    def _evacuate(self, migrate) -> None:
+        """drain(migrate=...)'s zero-token-loss evacuation: under ONE
+        `_step_lock` hold — so no decode can interleave between a
+        snapshot and its release, and no token is ever generated on
+        two replicas — snapshot every live slot and pending request
+        and offer each to the `migrate(snapshot, request) -> bool`
+        callback (the ReplicatedRouter's drain wires this to a
+        healthy replica's import). True = evacuated (released here,
+        resumed there, handle mirrored by the caller); False or an
+        export failure leaves the request in place for the normal
+        drain wait. Requests mid-admission finish their (bounded)
+        prefill normally."""
+        led = self._migration
+        with self._step_lock:
+            if self._inflight is not None:
+                self._commit_inflight()
+            job_slots = {s for job in self._jobs for s in job.slots}
+            for sid, slot in enumerate(self._slots):
+                if slot is None or sid in job_slots:
+                    continue
+                req = slot.req
+                if req._cancel.is_set() or req._done.is_set():
+                    continue
+                led.record_export_start()
+                try:
+                    if self._faults is not None:
+                        self._faults.check("migrate_export")
+                    snap, sid2, committed = (
+                        self._export_request_locked(req, "drain"))
+                except Exception:
+                    led.record_export_failed()
+                    continue
+                if not migrate(snap, req):
+                    led.record_export_failed()
+                    continue
+                self._evacuate_request_locked(req, sid2, committed)
+                led.record_export_done(len(snap.tokens),
+                                       snap.n_kv_pages())
+            with self._lock:
+                pend = list(self._pending)
+            for req in pend:
+                if req._cancel.is_set():
+                    continue
+                led.record_export_start()
+                try:
+                    if self._faults is not None:
+                        self._faults.check("migrate_export")
+                    snap = self._build_snapshot(req, "drain", (), None)
+                except Exception:
+                    led.record_export_failed()
+                    continue
+                if not migrate(snap, req):
+                    led.record_export_failed()
+                    continue
+                try:
+                    self._evacuate_request_locked(req, None, [])
+                except RuntimeError:
+                    # cancelled out of the queue mid-offer; the
+                    # destination's copy completes (or cancels) on
+                    # its own — nothing was lost here
+                    led.record_export_failed()
+                    continue
+                led.record_export_done(len(snap.tokens), 0)
 
     def _fail_all(self, exc: BaseException) -> None:
         # BOUNDED step-lock acquire: teardown serializes against any
@@ -3985,7 +4360,7 @@ class PagedInferenceServer:
         self._thread.start()
         return self
 
-    def drain(self, timeout: float | None = None, *,
+    def drain(self, timeout: float | None = None, *, migrate=None,
               _resume_on_timeout: bool = True) -> bool:
         """Graceful drain: refuse new submissions, let everything
         already accepted run to completion. Returns True once idle —
@@ -3997,9 +4372,18 @@ class PagedInferenceServer:
         thread. `_resume_on_timeout=False` is stop(drain=True)'s
         internal latch: a timed-out drain there must NOT reopen
         submission in the window before _stop is set, or a request
-        could be accepted just to be failed."""
+        could be accepted just to be failed.
+
+        `migrate` turns the drain into a zero-token-loss EVACUATION:
+        a `migrate(snapshot, request) -> bool` callback (see
+        `_evacuate`; `ReplicatedRouter.drain(migrate=True)` builds
+        one) is offered every live request, and each accepted offer
+        moves the request to another replica instead of waiting it
+        out. Whatever the callback declines drains normally."""
         with self._lock:
             self._draining = True
+        if migrate is not None:
+            self._evacuate(migrate)
         deadline = (None if timeout is None
                     else time.perf_counter() + timeout)
 

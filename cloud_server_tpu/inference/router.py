@@ -94,16 +94,31 @@ class ReplicatedRouter:
         replica (excluding every replica it already failed on) and the
         original Request handle completes with the retry's outcome;
         its trace gains a `router_retry` span in the same trace tree.
-        A partially-streamed request fails fast instead (the HTTP
-        front-end marks it `"retriable": false`).
+      * A request that already STREAMED tokens is MIGRATED instead
+        (inference/migration.py): its host state — generated tokens,
+        position-keyed RNG seed, grammar progress, deadline
+        remainder — is salvaged from the handle and resumed on a
+        healthy replica at the exact next token, on the same stream
+        (greedy outputs are token-identical to an uninterrupted run;
+        seeded sampling is exact because RNG streams are
+        position-keyed). The trace gains a `migrate` span in the same
+        tree. Only when migration cannot proceed (export fault, no
+        healthy replica, past deadline, non-migratable backend) does
+        the old fail-fast contract apply and the HTTP front-end marks
+        the failure `"retriable": false`.
+      * `drain(replica_index)` evacuates a replica for maintenance:
+        every active request live-migrates to a healthy replica
+        before the drain waits out whatever could not move — replica
+        maintenance is a zero-token-loss operation.
       * Every failure trips the failing replica's breaker: after
         `breaker_threshold` consecutive failures it OPENS (excluded
         from placement), after `breaker_reset_s` it half-opens for one
         probe submit, and a probe success closes it again.
 
     Breaker state is surfaced on /healthz (`breaker_states()`), and
-    the retry/failover/breaker counters ride `metrics_snapshot()` with
-    the `cloud_server_router_` families (docs/observability.md)."""
+    the retry/failover/migration/breaker counters ride
+    `metrics_snapshot()` with the `cloud_server_router_` families
+    (docs/observability.md)."""
 
     def __init__(self, replicas: Sequence, *,
                  breaker_threshold: int = 3,
@@ -142,6 +157,20 @@ class ReplicatedRouter:
         self._m_retry_success = reg.counter(
             "router_retry_success_total",
             "Failover retries whose resubmission completed normally")
+        self._m_migrations = reg.counter(
+            "router_migrations_total",
+            "Mid-stream failures and drain evacuations handed to "
+            "live migration (state salvaged, resumption dispatched)")
+        self._m_migration_success = reg.counter(
+            "router_migration_success_total",
+            "Live migrations whose resumed request completed "
+            "normally on the destination replica")
+        self._migration_ms = reg.histogram(
+            "migration_ms",
+            "Live-migration handoff latency (failure or drain offer "
+            "through destination re-admission), ms",
+            buckets=(1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                     500.0, 1000.0, 2500.0, 5000.0))
         self._m_breaker_open = reg.counter(
             "router_breaker_open_total",
             "Circuit-breaker open transitions (closed/half_open -> "
@@ -381,12 +410,15 @@ class ReplicatedRouter:
             return False
         self._record_breaker_failure(replica)
         excluded = set(excluded) | {replica}
-        # the SAFE-RETRY rule: only a request that streamed NOTHING
-        # may be resubmitted (at-most-once token delivery); a
-        # partially-streamed request fails fast and the HTTP layer
-        # marks it retriable: false
-        if req.tokens or orig.tokens:
-            return False
+        # the SAFE-RETRY rule, upgraded by live migration: a request
+        # that streamed NOTHING resubmits plainly (at-most-once token
+        # delivery — nothing to duplicate); one that already streamed
+        # is MIGRATED — host state salvaged from the handle, resumed
+        # on a healthy replica at the exact next token. Only when the
+        # migration cannot even start (checks below, export fault,
+        # non-migratable backend) does the failure stand and the HTTP
+        # layer mark it retriable: false.
+        mid_stream = bool(req.tokens or orig.tokens)
         if orig._cancel.is_set():
             return False
         if (orig.deadline is not None
@@ -401,6 +433,26 @@ class ReplicatedRouter:
                        and self._breaker_admits_locked(j, now)
                        for j, r in enumerate(self.replicas)):
                 return False  # nowhere healthy to retry
+        if mid_stream:
+            salvage = getattr(self.replicas[replica],
+                              "migrate_salvage", None)
+            if salvage is None:
+                return False  # backend without migration: fail fast
+            # whichever handle carries MORE of the stream is the
+            # truth (req is the failing hop's request — on hop > 1 it
+            # holds the full pre-filled stream; orig only mirrors at
+            # success)
+            src = req if len(req.tokens) >= len(orig.tokens) else orig
+            try:
+                snap = salvage(src, reason="failover")
+            except Exception:  # noqa: BLE001 — injected or real
+                return False  # export failed: the old contract stands
+            self._m_migrations.inc()
+            threading.Thread(
+                target=self._migrate_submit,
+                args=(orig, snap, replica, excluded, kw),
+                daemon=True, name="router-migrate").start()
+            return True
         self._m_retries.inc()
         threading.Thread(
             target=self._retry_submit,
@@ -500,6 +552,99 @@ class ReplicatedRouter:
         # could not resubmit anywhere: the original failure stands
         orig._done.set()
 
+    def _migrate_submit(self, orig, snap, from_replica: int,
+                        excluded: set, kw) -> None:
+        """Resume a salvaged mid-stream request on a healthy replica
+        (migration worker thread; `_retry_submit`'s shape, but the
+        re-admission goes through `migrate_import` so the destination
+        resumes at the exact next token). The ORIGINAL Request stays
+        the client's handle: the continuation emits only NEW tokens
+        through the same stream callback, joins the same trace
+        (gaining a `migrate` span), and on completion mirrors its
+        outcome onto the original before unblocking its waiters."""
+        t_fail = time.perf_counter()
+        deadline_s = None
+        if orig.deadline is not None:
+            remaining = orig.deadline - time.perf_counter()
+            if remaining <= 0:
+                orig._done.set()  # expired while handing off
+                return
+            deadline_s = remaining
+        tr0 = getattr(orig, "trace", None)
+        trace_ctx = (None if tr0 is None
+                     else (tr0.trace_id, tr0.root_span_id, True))
+        while True:
+            with self._lock:
+                i = self._pick(tenant=kw.get("tenant"),
+                               count_inflight=True, exclude=excluded,
+                               strict=True)
+            if i is None:
+                break  # nothing healthy left: the failure stands
+            imp = getattr(self.replicas[i], "migrate_import", None)
+            if imp is None:
+                # non-migratable backend: skip it for THIS request
+                # without a breaker event (it did nothing wrong)
+                with self._lock:
+                    self._inflight[i] -= 1
+                self._release_probe(i)
+                excluded.add(i)
+                if len(excluded) >= len(self.replicas):
+                    break
+                continue
+            hook = (self._make_fail_hook(
+                        i, list(snap.prompt), dict(kw),
+                        frozenset(excluded), orig)
+                    if self._accepts_hook[i] else None)
+            try:
+                new = imp(snap, stream=kw.get("stream"),
+                          fail_handler=hook, trace_ctx=trace_ctx,
+                          deadline_s=deadline_s)
+            except Exception as exc:  # noqa: BLE001 — any refusal: next
+                with self._lock:
+                    self._inflight[i] -= 1
+                if (isinstance(exc, RuntimeError)
+                        and not isinstance(exc, QueueFullError)
+                        and getattr(self.replicas[i], "ready", True)):
+                    self._record_breaker_failure(i)
+                else:
+                    self._release_probe(i)
+                excluded.add(i)
+                if len(excluded) >= len(self.replicas):
+                    break
+                continue
+            with self._lock:
+                self._inflight[i] -= 1
+            self._record_breaker_success(i)
+            # same mirroring/cancel-chain contract as _retry_submit
+            # (see the comments there); _router_migrated routes the
+            # success onto the migration counter instead of retry's
+            new._router_orig = orig
+            new._router_migrated = True
+            new._on_done = self._mirror_retry
+            with self._lock:
+                gen = len(excluded)
+                if gen >= getattr(orig, "_router_cancel_gen", -1):
+                    orig._router_cancel_gen = gen
+                    orig._on_cancel = lambda _r, _n=new: _n.cancel()
+            if orig._cancel.is_set():
+                new.cancel()
+            tr = getattr(new, "trace", None)
+            if tr is not None:
+                tr.annotate(replica=i, migrate_of=orig.request_id)
+                tr.add_span("migrate", t_fail, time.perf_counter(),
+                            from_replica=from_replica, replica=i,
+                            attempt=len(excluded),
+                            reason=snap.reason,
+                            tokens_salvaged=len(snap.tokens),
+                            kv_pages=snap.n_kv_pages())
+            self._migration_ms.observe(
+                (time.perf_counter() - t_fail) * 1e3)
+            if new.done:
+                self._mirror_retry(new)
+            return
+        # could not resume anywhere: the original failure stands
+        orig._done.set()
+
     def _mirror_retry(self, new) -> None:
         """Request._on_done of a retry: copy the outcome onto the
         original handle and unblock its waiters (tokens already
@@ -520,7 +665,10 @@ class ReplicatedRouter:
         orig.finish_reason = new.finish_reason
         if (new.finish_reason is not None
                 and not new.finish_reason.startswith("error")):
-            self._m_retry_success.inc()
+            if getattr(new, "_router_migrated", False):
+                self._m_migration_success.inc()
+            else:
+                self._m_retry_success.inc()
         orig._done.set()
 
     def generate(self, prompts, *, max_new_tokens=None):
@@ -848,6 +996,126 @@ class ReplicatedRouter:
         for r in self.replicas:
             r.start()
         return self
+
+    def drain(self, replica_index: int, *,
+              timeout: float | None = None,
+              migrate: bool = True) -> bool:
+        """Drain ONE replica for maintenance. With `migrate=True`
+        (default) every active request is first EVACUATED: exported
+        at the replica's commit point and resumed on a healthy
+        replica at the exact next token, on the same stream — a
+        zero-token-loss operation. Whatever cannot move (export
+        fault, no healthy destination, non-migratable state) is
+        waited out by the normal drain. Returns the replica drain's
+        verdict (True = idle/quiesced; resume() it to serve again)."""
+        src = self.replicas[replica_index]
+
+        def _migrate_cb(snap, req) -> bool:
+            t0 = time.perf_counter()
+            excluded = {replica_index}
+            kw = {"tenant": snap.tenant,
+                  "stream": getattr(req, "stream", None)}
+            while True:
+                with self._lock:
+                    i = self._pick(tenant=snap.tenant,
+                                   count_inflight=True,
+                                   exclude=excluded, strict=True)
+                if i is None:
+                    return False
+                imp = getattr(self.replicas[i], "migrate_import", None)
+                if imp is None:
+                    with self._lock:
+                        self._inflight[i] -= 1
+                    self._release_probe(i)
+                    excluded.add(i)
+                    if len(excluded) >= len(self.replicas):
+                        return False
+                    continue
+                self._m_migrations.inc()
+                hook = (self._make_fail_hook(
+                            i, list(snap.prompt), dict(kw),
+                            frozenset(excluded), req)
+                        if self._accepts_hook[i] else None)
+                try:
+                    new = imp(snap, stream=kw["stream"],
+                              fail_handler=hook)
+                except Exception as exc:  # noqa: BLE001 — next replica
+                    with self._lock:
+                        self._inflight[i] -= 1
+                    if (isinstance(exc, RuntimeError)
+                            and not isinstance(exc, QueueFullError)
+                            and getattr(self.replicas[i], "ready",
+                                        True)):
+                        self._record_breaker_failure(i)
+                    else:
+                        self._release_probe(i)
+                    excluded.add(i)
+                    if len(excluded) >= len(self.replicas):
+                        return False
+                    continue
+                with self._lock:
+                    self._inflight[i] -= 1
+                self._record_breaker_success(i)
+                # same mirroring/cancel-chain contract as
+                # _migrate_submit: the evacuated request handle stays
+                # the client's, the destination's outcome mirrors back
+                new._router_orig = req
+                new._router_migrated = True
+                new._on_done = self._mirror_retry
+                with self._lock:
+                    gen = len(excluded)
+                    if gen >= getattr(req, "_router_cancel_gen", -1):
+                        req._router_cancel_gen = gen
+                        req._on_cancel = lambda _r, _n=new: _n.cancel()
+                if req._cancel.is_set():
+                    new.cancel()
+                tr = getattr(new, "trace", None)
+                if tr is not None:
+                    tr.annotate(replica=i, migrate_of=req.request_id)
+                    tr.add_span("migrate", t0, time.perf_counter(),
+                                from_replica=replica_index, replica=i,
+                                reason="drain",
+                                tokens_salvaged=len(snap.tokens),
+                                kv_pages=snap.n_kv_pages())
+                self._migration_ms.observe(
+                    (time.perf_counter() - t0) * 1e3)
+                if new.done:
+                    self._mirror_retry(new)
+                return True
+
+        if migrate:
+            try:
+                return src.drain(timeout, migrate=_migrate_cb)
+            except TypeError:
+                # replica without migration support: fall through to
+                # the plain wait-it-out drain below, VISIBLY
+                _log.warning(
+                    "replica %d drain() does not accept migrate=; "
+                    "draining without evacuation", replica_index)
+        return src.drain(timeout)
+
+    def migration_stats(self) -> dict:
+        """FLEET-wide live-migration counters (the /stats `migration`
+        source behind the router): every replica's ledger sums
+        (export + import halves), and `success_rate` — resumptions
+        admitted per export attempted — recomputes from the merged
+        totals (the `tenant_fair_share` ratio rule: ratios never
+        add)."""
+        keys = ("out_started", "out_completed", "out_failed",
+                "in_started", "in_completed", "in_failed", "started",
+                "completed", "failed", "tokens_salvaged",
+                "pages_moved")
+        merged = {k: 0 for k in keys}
+        for r in self.replicas:
+            fn = getattr(r, "migration_stats", None)
+            if fn is None:
+                continue
+            s = fn()
+            for k in keys:
+                merged[k] += s.get(k, 0)
+        merged["success_rate"] = (merged["in_completed"]
+                                  / max(merged["out_started"], 1))
+        return merged
 
     def stop(self, drain: bool = False,
              timeout: float | None = None) -> None:

@@ -241,6 +241,65 @@ def test_checker_flags_bad_fault_paths():
                             ("BadOverloadDetector.shed_fine",))
 
 
+def test_registry_covers_migration():
+    """Live migration rides all three passes: the ledger's record
+    hooks run while a scheduler's step lock is held (hot-path), the
+    module itself is host policy (DD3), the export's KV gather is a
+    sanctioned sync with the whole export/import path on the DD2
+    scheduler roster, and the ledger's leaf lock is lock-discipline
+    audited."""
+    from cloud_server_tpu.analysis import locks
+    quals = set(HOT_PATHS["cloud_server_tpu/inference/migration.py"])
+    for needed in ("MigrationLedger.record_export_done",
+                   "MigrationLedger.record_import_done",
+                   "MigrationLedger.drain_flight_deltas",
+                   "MigrationSnapshot.remaining_new_tokens"):
+        assert needed in quals, f"{needed} dropped from HOT_PATHS"
+    assert ("cloud_server_tpu/inference/migration.py"
+            in dispatch.HOST_POLICY_MODULES), \
+        "migration.py dropped from the DD3 host-policy roster"
+    assert ("cloud_server_tpu/inference/migration.py"
+            in locks.LOCK_ROSTER), \
+        "migration.py dropped from the lock-discipline roster"
+    paged = "cloud_server_tpu/inference/paged_server.py"
+    assert ("PagedInferenceServer._export_request_locked"
+            in dispatch.SANCTIONED_SYNCS[paged]), \
+        "the migration export's sync lost its DD2 sanction"
+    loop = set(dispatch.SCHEDULER_LOOPS[paged])
+    for needed in ("PagedInferenceServer.migrate_export",
+                   "PagedInferenceServer.migrate_import",
+                   "PagedInferenceServer._import_pages",
+                   "PagedInferenceServer._evacuate"):
+        assert needed in loop, f"{needed} dropped from the DD2 roster"
+
+
+def test_checker_flags_bad_migration_paths():
+    """Fixture round-trip proving the checker is LIVE on the new
+    module's violation shapes: logging/IO from record hooks that run
+    under a scheduler's step lock, wall-clock flight-delta stamps,
+    numpy counter buffers, a second sync after the export's sanctioned
+    one, a pacing sleep — each must fire; the int-add ledger shape the
+    real module uses must not."""
+    src = (_FIXTURES / "hot_path_migration_bad.py").read_text()
+    cases = {
+        "BadMigrationLedger.record_export_done_logged": "logging",
+        "BadMigrationLedger.record_import_done_io": "I/O",
+        "BadMigrationLedger.drain_flight_wall_clock": "time.time",
+        "BadMigrationLedger.stats_numpy": "numpy",
+        "BadMigrationLedger.record_export_synced": "sync",
+        "BadMigrationLedger.record_import_sleepy": "sleep",
+    }
+    for qual, needle in cases.items():
+        findings = check_source("hot_path_migration_bad.py", src,
+                                (qual,))
+        assert findings, f"{qual}: expected a finding"
+        assert any(needle in f.message for f in findings), \
+            f"{qual}: {[str(f) for f in findings]}"
+    assert not check_source(
+        "hot_path_migration_bad.py", src,
+        ("BadMigrationLedger.record_export_done_fine",))
+
+
 def test_checker_accepts_clean_fixture():
     src = (_FIXTURES / "hot_path_good.py").read_text()
     findings = check_source("hot_path_good.py", src,

@@ -428,11 +428,15 @@ def _assert_gap_free(tree):
 def test_router_failover_e2e(params):
     """Injected dispatch failure on replica 0 mid-flood: the breaker
     opens, the zero-token request retries and completes on replica 1
-    with EXACT greedy output, the partially-streamed request fails
-    fast, and the trace trees stay gap-free across the retry hop."""
+    with EXACT greedy output, the partially-streamed request is LIVE-
+    MIGRATED (host state salvaged from the handle, resumed on replica
+    1 at the exact next token — no token lost, none duplicated on its
+    stream), and the trace trees stay gap-free across both hops."""
     long_prompt = [(k * 5) % 60 + 1 for k in range(40)]
+    mid_prompt = [(k * 7) % 60 + 1 for k in range(8)]
     lone = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
     want = lone.generate([long_prompt], max_new_tokens=6)[0]
+    want_a = lone.generate([mid_prompt], max_new_tokens=20)[0]
 
     fp = FaultPlan()
     r0 = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW,
@@ -443,8 +447,8 @@ def test_router_failover_e2e(params):
                               breaker_reset_s=60.0)
     streamed = []
     # a: lands on replica 0 (least loaded, rotation 0) and streams
-    # a couple of tokens -> NOT retriable after the crash
-    a = router.submit([(k * 7) % 60 + 1 for k in range(8)],
+    # a couple of tokens -> MIGRATED after the crash
+    a = router.submit(mid_prompt,
                       max_new_tokens=20, stream=streamed.append)
     while len(a.tokens) < 2:
         router.step()
@@ -456,12 +460,15 @@ def test_router_failover_e2e(params):
     assert b.tokens == []
     fp.arm("dispatch", count=1)  # next replica-0 dispatch raises
     deadline = time.time() + 60
-    while not b.done and time.time() < deadline:
+    while not (b.done and a.done) and time.time() < deadline:
         router.step()
         time.sleep(0.001)
-    # partially-streamed: fails fast with the original error
-    assert a.done and a.finish_reason.startswith("error")
-    assert len(a.tokens) >= 2
+    # partially-streamed: live-migrated to replica 1 and completed
+    # with the EXACT uninterrupted greedy stream — the tokens salvaged
+    # before the crash plus the continuation, no loss, no duplication
+    assert a.done and a.finish_reason == "length"
+    assert a.tokens == want_a
+    assert streamed == want_a
     # zero-token: retried and completed on replica 1, exact greedy
     assert b.done and b.finish_reason == "length"
     assert b.tokens == want
@@ -473,6 +480,15 @@ def test_router_failover_e2e(params):
     assert snap["cloud_server_router_retries_total"]["value"] == 1
     assert snap["cloud_server_router_retry_success_total"][
         "value"] == 1
+    assert snap["cloud_server_router_migrations_total"]["value"] == 1
+    assert snap["cloud_server_router_migration_success_total"][
+        "value"] == 1
+    assert snap["cloud_server_migration_ms"]["count"] == 1
+    mstats = router.migration_stats()
+    assert mstats["out_completed"] == 1
+    assert mstats["in_completed"] == 1
+    assert mstats["success_rate"] == 1.0
+    assert mstats["tokens_salvaged"] >= 2
     assert snap["cloud_server_router_breaker_open_total"]["value"] == 1
     assert snap['cloud_server_router_breaker_state{replica="0"}'][
         "value"] == 2
@@ -489,6 +505,21 @@ def test_router_failover_e2e(params):
                       if t["root"]["tags"].get("retry_of"))
     span_names = [c["name"] for c in retry_tree["root"]["children"]]
     assert "router_retry" in span_names
+    # a's migration: one trace id across both replicas, the
+    # continuation tree carries the `migrate` span with the hand-off
+    # provenance
+    a_trees = [t for t in trees
+               if t["request_id"] == a.request_id
+               or t["root"]["tags"].get("migrate_of") == a.request_id]
+    assert len(a_trees) == 2
+    assert len({t["trace_id"] for t in a_trees}) == 1
+    mig_tree = next(t for t in a_trees
+                    if t["root"]["tags"].get("migrate_of"))
+    mig_spans = [c for c in mig_tree["root"]["children"]
+                 if c["name"] == "migrate"]
+    assert mig_spans
+    assert mig_spans[0]["tags"]["reason"] == "failover"
+    assert mig_spans[0]["tags"]["tokens_salvaged"] >= 2
     for t in trees:
         if t["root"]["end"] is not None:
             _assert_gap_free(t)
