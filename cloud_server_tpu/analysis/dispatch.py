@@ -74,6 +74,7 @@ CHECKER = "dispatch-discipline"
 SCHEDULER_LOOPS: dict[str, tuple[str, ...]] = {
     "cloud_server_tpu/inference/paged_server.py": (
         "PagedInferenceServer.step",
+        "PagedInferenceServer._step_sequential",
         "PagedInferenceServer.serve_forever",
         "PagedInferenceServer._step_overlap",
         "PagedInferenceServer._plan_iteration",
@@ -122,6 +123,14 @@ SCHEDULER_LOOPS: dict[str, tuple[str, ...]] = {
         "PagedInferenceServer._evacuate",
         "PagedInferenceServer.migrate_import",
         "PagedInferenceServer._import_pages",
+        # disaggregation handoff: the prefetch runs on the iteration
+        # path right before the mixed dispatch (its copy_to_host_async
+        # STARTS are pragma-sanctioned — they are not host syncs), the
+        # drain runs at the end of every step outside the step lock,
+        # and pending_prefill_tokens is the router's prefill-load read
+        "PagedInferenceServer._handoff_prefetch",
+        "PagedInferenceServer._drain_handoff_ready",
+        "PagedInferenceServer.pending_prefill_tokens",
     ),
     "cloud_server_tpu/inference/server.py": (
         "InferenceServer.step",
@@ -184,6 +193,12 @@ OVERLAP_PLAN_FUNCS: dict[str, tuple[str, ...]] = {
         "PagedInferenceServer._launch_plan",
         "PagedInferenceServer._build_prefill_group",
         "PagedInferenceServer._select_prefill",
+        # the handoff KV prefetch runs inside _launch_plan while the
+        # PREVIOUS dispatch may still be in flight: it reads committed
+        # pages and starts D2H copies but must never release a page —
+        # and must never reach the export path (whose device_get is
+        # sanctioned only OFF the plan path)
+        "PagedInferenceServer._handoff_prefetch",
     ),
 }
 PAGE_RELEASING_FUNCS = frozenset({

@@ -142,6 +142,11 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "ReplicatedRouter.tenant_stats",
         "ReplicatedRouter.speculation_stats",
         "ReplicatedRouter.cache_stats",
+        # disaggregation role planner: runs inside every _pick/submit
+        # under the router lock, same stall blast radius
+        "ReplicatedRouter._role_candidates",
+        "ReplicatedRouter._prefill_load",
+        "ReplicatedRouter._plan_roles",
     ),
     # live migration: the ledger's record hooks run on the export /
     # import paths while the SOURCE or DESTINATION server's step lock

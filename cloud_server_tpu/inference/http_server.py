@@ -708,6 +708,11 @@ class HttpFrontend:
         brfn = getattr(self.srv, "breaker_states", None)
         if brfn is not None:
             payload["breakers"] = brfn()
+        # replica role map (disaggregated prefill/decode fleets; all
+        # "colocated" when no roles are configured)
+        rfn = getattr(self.srv, "replica_roles", None)
+        if rfn is not None:
+            payload["roles"] = rfn()
         # multi-tenant QoS: per-tenant counters + fair-share view.
         # ReplicatedRouter merges these across replicas
         # (tenant_stats()); a single server reports its registry's.

@@ -1352,6 +1352,23 @@ class PagedInferenceServer:
         # deferred sweep reaps: (slot_id, _Slot, reason) marked while a
         # dispatch is in flight; released right after its commit
         self._reaped: list[tuple[int, _Slot, str]] = []
+        # disaggregated prefill/decode handoff (the ReplicatedRouter's
+        # role-specialized fleets): requests whose chunked prefill
+        # completed THIS iteration and that carry a submit-time
+        # `handoff=` callback queue here; step() fires the callbacks
+        # AFTER releasing _step_lock (the callback typically enqueues a
+        # migrate_export, which needs that lock). Scheduler-thread-only
+        # state — appended under _step_lock, drained on the same thread
+        # right after it is released.
+        self._handoff_ready: list[Request] = []
+        # request_id -> (page_ids, device gathers with their host
+        # copies already started): KV prefetched by _handoff_prefetch
+        # BEFORE the final prefill chunk's dispatch (donation
+        # invalidates the pools after launch), consumed by
+        # _export_request_locked so the handoff export pays only the
+        # pages the last chunks wrote. Popped on export or request
+        # completion, whichever comes first.
+        self._handoff_stash: dict[str, tuple[tuple[int, ...], dict]] = {}
         # perf_counter stamp of the launch performed THIS iteration
         # (consumed by _record_iteration into the flight record's
         # t_launch — the Perfetto inflight track's left edge)
@@ -1369,7 +1386,8 @@ class PagedInferenceServer:
                tenant: str | None = None,
                trace_ctx: tuple | None = None,
                deadline_s: float | None = None,
-               fail_handler=None, _migration=None) -> Request:
+               fail_handler=None, handoff=None,
+               _migration=None) -> Request:
         if self._stop.is_set():
             raise RuntimeError("server is stopped; not accepting requests")
         if self._faults is not None:
@@ -1465,6 +1483,12 @@ class PagedInferenceServer:
         # pending queue any scheduler crash may complete it, and a
         # hook landing late would miss its own failure
         req._fail_handler = fail_handler
+        # disaggregated handoff callback (role-specialized fleets):
+        # fired once, outside _step_lock, when this request's chunked
+        # prefill completes with decode budget left — the router's
+        # hook migrates it to a decode replica. Rides IN through
+        # submit for the same reason fail_handler does.
+        req._handoff = handoff
         req._on_cancel = self._handle_cancel  # before it can be seen
         with self._lock:
             # under the lock: drain() flips _draining under the same
@@ -1484,8 +1508,14 @@ class PagedInferenceServer:
                 # budget 429s while every other tenant keeps admitting.
                 # On failure nothing was mutated for this request; on
                 # success the tenant's pending count advances atomically
-                # with the append below.
-                self.qos.gate_submit(tenant, len(prompt))
+                # with the append below. A migration continuation bills
+                # ZERO prompt tokens: its prompt was already charged on
+                # the source replica and its salvaged tokens were never
+                # prompt tokens — re-billing would double-charge the
+                # tenant fleet-wide for one request.
+                self.qos.gate_submit(
+                    tenant, len(prompt),
+                    charge_tokens=0 if _migration is not None else None)
             # telemetry BEFORE the append: once the request is in the
             # queue the scheduler thread may admit (even finish) it, and
             # the timeline must stay in lifecycle order. The trace
@@ -1536,6 +1566,11 @@ class PagedInferenceServer:
         waiters stay blocked until the retry finishes and mirrors its
         outcome back."""
         self.metrics.observe_finish(req)
+        # analysis: allow[lock-discipline] GIL-atomic dict pop: drop
+        # any unconsumed handoff KV prefetch (the request ended
+        # locally before the export fired) — safe from any completing
+        # thread, no compound read-modify-write
+        self._handoff_stash.pop(req.request_id, None)
         if self.trace_recorder is not None and req.trace is not None:
             self.trace_recorder.finish(req)
         h = req._fail_handler
@@ -1596,6 +1631,26 @@ class PagedInferenceServer:
     def num_pending(self) -> int:
         with self._lock:
             return len(self._pending)
+
+    @property
+    def pending_prefill_tokens(self) -> int:
+        """Prefill tokens this replica still owes: the unprefilled
+        remainder of every in-flight admission job plus the full
+        admission length of everything queued. The ReplicatedRouter's
+        role-aware placement reads this as a PREFILL replica's load
+        signal (a 4k-token prompt is not the same backlog as a
+        4-token one, which request counts cannot see)."""
+        # analysis: allow[lock-discipline] racy-by-design monitoring
+        # read of _jobs (scheduler-thread state): list() snapshots the
+        # container, element reads are GIL-atomic, staleness is bounded
+        # by one iteration and only steers placement
+        jobs = list(self._jobs)
+        n = sum(max(int(job.rem_lens[0]) - job.done, 0)
+                for job in jobs)
+        with self._lock:
+            n += sum(len(r.prompt) + len(r.tokens)
+                     for r in self._pending)
+        return n
 
     def prefix_cache_stats(self):
         """Allocator snapshot (AllocatorStats). Called from the scrape
@@ -2492,6 +2547,11 @@ class PagedInferenceServer:
                 if self._emit(slot.req, int(job.toks[0]),
                               float(job.lps[0])):
                     self._finish(sid)
+                elif getattr(slot.req, "_handoff", None) is not None:
+                    # prefill complete with decode budget left: queue
+                    # the disaggregation handoff callback; fired
+                    # OUTSIDE _step_lock at the end of this step
+                    self._handoff_ready.append(slot.req)
             self._jobs.remove(job)
 
     # -- mixed (stall-free) scheduling --------------------------------------
@@ -2632,6 +2692,47 @@ class PagedInferenceServer:
                 "scatm": scatm, "gid_g": gid_g, "gst0_g": gst0_g,
                 "aid_g": aid_g, "sel_mask": sel_mask}
 
+    def _handoff_prefetch(self, sel) -> None:
+        """Overlapped KV export for the disaggregation handoff: for
+        every selected admission that COMPLETES its prefill in the
+        dispatch about to launch and carries a `handoff=` callback,
+        gather the pages PRIOR chunks fully committed and start their
+        device->host copies now — the transfer rides under the final
+        chunk's compute, so the export at the handoff's commit point
+        pays only the last chunk's pages (≤1 iteration of exposed
+        latency). Must run BEFORE the dispatch statement: the dispatch
+        donates `self.state`, so the pool buffers are invalid after
+        the launch. Read-only — allocates nothing, releases nothing —
+        so it is safe on the DD5 plan/launch path; the stash is
+        validated (page-id prefix match) and consumed by
+        `_export_request_locked`, or dropped at request completion."""
+        ps = self.page_size
+        for job, take, d0 in sel:
+            if d0 + take < int(job.rem_lens[0]):
+                continue  # not the final chunk
+            sid = job.slots[0]
+            slot = self._slots[sid]
+            if slot is None or getattr(slot.req, "_handoff", None) is None:
+                continue
+            n_full = (int(job.base_lens[0]) + d0) // ps
+            if n_full <= 0 or slot.req.request_id in self._handoff_stash:
+                continue
+            ids = np.asarray(slot.pages[:n_full])
+            gathered = {name: pool[:, ids]
+                        for name, pool in self.state["pools"].items()}
+            draft = self.state.get("draft_pools")
+            if draft is not None:
+                for name, pool in draft.items():
+                    gathered["draft/" + name] = pool[:, ids]
+            for arr in gathered.values():
+                # analysis: allow[dispatch-discipline] async D2H copy
+                # START, not a host sync: nothing blocks here — the
+                # copy overlaps the final prefill chunk and the
+                # export's sanctioned device_get collects it
+                arr.copy_to_host_async()
+            self._handoff_stash[slot.req.request_id] = (
+                tuple(slot.pages[:n_full]), gathered)
+
     def _mixed_dispatch(self) -> None:
         """One token-budget iteration: the multi-round decode dispatch
         for every live slot plus as many prefill-chunk tokens as fit
@@ -2737,6 +2838,10 @@ class PagedInferenceServer:
             # transfer + launch) through the sanctioned device_get is
             # the device phase
             prof.mark("build")
+        # disaggregation handoff: start the committed-page D2H copies
+        # BEFORE the dispatch donates self.state (overlaps the final
+        # prefill chunk)
+        self._handoff_prefetch(sel)
         self.state, ptoks, plps, lens, last, (toks, lps, counts) = \
             _mixed_step(
                 self.params, self.state, jnp.asarray(pf["chunk"]),
@@ -3088,6 +3193,11 @@ class PagedInferenceServer:
             self._pad_limits(plan.spec_lens, int(plan.live_g.shape[0]))))
         if plan.kind == "mixed":
             pf = plan.pf
+            # disaggregation handoff: the in-flight dispatch committed
+            # before this launch, so the plan's sel cursors equal the
+            # committed ones — start the D2H copies for admissions the
+            # plan completes, before the dispatch donates self.state
+            self._handoff_prefetch(plan.sel)
             self.state, ptoks, plps, lens, last, (toks, lps, counts) = \
                 _mixed_step(
                     self.params, self.state, jnp.asarray(pf["chunk"]),
@@ -3258,6 +3368,37 @@ class PagedInferenceServer:
             s.req.finish_reason = reason
             self._complete(s.req)
 
+    def _drain_handoff_ready(self) -> None:
+        """Fire the queued disaggregation handoff callbacks — OUTSIDE
+        `_step_lock`, on the scheduler thread, right after the step
+        that activated them: the callback (the ReplicatedRouter's
+        hook) enqueues a `migrate_export`, which needs the step lock
+        this thread just released. Each request's callback fires at
+        most once; a request that finished or cancelled between
+        activation and here is skipped. Callback exceptions are the
+        router's problem, never the scheduler's — the request keeps
+        decoding locally either way (the handoff is an optimization,
+        not a correctness event)."""
+        # analysis: allow[lock-discipline] scheduler-thread-only list:
+        # appended inside the step (under _step_lock) and drained here
+        # on the SAME thread right after the lock releases — no second
+        # accessor exists, the guard inference is a false positive
+        if not self._handoff_ready:
+            return
+        # analysis: allow[lock-discipline] same scheduler-thread-only
+        # swap as above
+        ready, self._handoff_ready = self._handoff_ready, []
+        for req in ready:
+            h = req._handoff
+            req._handoff = None  # at most once
+            if (h is None or req._done.is_set()
+                    or req._cancel.is_set()):
+                continue
+            try:
+                h(req)
+            except Exception:  # noqa: BLE001 — router-side failure
+                pass
+
     def _step_overlap(self) -> int:
         """One pipelined scheduler iteration (overlap on). With a
         dispatch in flight: plan iteration N+1 (sweep marks, QoS/DRR
@@ -3268,7 +3409,8 @@ class PagedInferenceServer:
         With nothing in flight (cold start, post-drain, famine): run
         the byte-identical sequential iteration, then PRIME the
         pipeline by planning and launching the next dispatch before
-        returning."""
+        returning. Handoff callbacks queued by the step fire after
+        the lock releases (`_drain_handoff_ready`)."""
         with self._step_lock:
             self.tracer.step_start()
             prof = self._profiler
@@ -3308,34 +3450,37 @@ class PagedInferenceServer:
                         self.last_busy_ts = self._iter_stats["ts"]
                     else:
                         self.idle_iterations += 1
-                    return self.num_active
-                # steady state: one commit + one launch per step
-                self._overlap_sweep()
-                if prof is not None:
-                    prof.mark("sweep")
-                self._start_admissions()
-                if prof is not None:
-                    prof.mark("admission")
-                p0 = self.preemptions
-                t0 = (prof.t0 if prof is not None
-                      else time.perf_counter())
-                if self._faults is not None:
-                    # injected dispatch failure: ONE hit per step
-                    # (the fill path's site lives inside its
-                    # sequential dispatch), raised before the
-                    # commit below — serve_forever catches,
-                    # _fail_all drops the in-flight futures and
-                    # unblocks every waiter
-                    self._faults.check("dispatch")
-                plan = self._plan_iteration()
-                self._commit_inflight()
-                if plan is not None:
-                    self._launch_plan(plan)
-                self._record_iteration(t0, p0, c0)
-                self.last_busy_ts = self._iter_stats["ts"]
-                return self.num_active
+                    ret = self.num_active
+                else:
+                    # steady state: one commit + one launch per step
+                    self._overlap_sweep()
+                    if prof is not None:
+                        prof.mark("sweep")
+                    self._start_admissions()
+                    if prof is not None:
+                        prof.mark("admission")
+                    p0 = self.preemptions
+                    t0 = (prof.t0 if prof is not None
+                          else time.perf_counter())
+                    if self._faults is not None:
+                        # injected dispatch failure: ONE hit per step
+                        # (the fill path's site lives inside its
+                        # sequential dispatch), raised before the
+                        # commit below — serve_forever catches,
+                        # _fail_all drops the in-flight futures and
+                        # unblocks every waiter
+                        self._faults.check("dispatch")
+                    plan = self._plan_iteration()
+                    self._commit_inflight()
+                    if plan is not None:
+                        self._launch_plan(plan)
+                    self._record_iteration(t0, p0, c0)
+                    self.last_busy_ts = self._iter_stats["ts"]
+                    ret = self.num_active
             finally:
                 self.tracer.step_end()
+        self._drain_handoff_ready()
+        return ret
 
     # -- scheduler ----------------------------------------------------------
 
@@ -3418,6 +3563,14 @@ class PagedInferenceServer:
         below byte-identical to the pre-overlap build."""
         if self._overlap_enabled:
             return self._step_overlap()
+        ret = self._step_sequential()
+        self._drain_handoff_ready()
+        return ret
+
+    def _step_sequential(self) -> int:
+        """The sequential iteration body of step() (overlap off or the
+        alternating scheduler), split out so step() can fire handoff
+        callbacks AFTER `_step_lock` releases. Byte-identical work."""
         with self._step_lock:
             self.tracer.step_start()
             prof = self._profiler
@@ -4056,21 +4209,49 @@ class PagedInferenceServer:
         ps = self.page_size
         n_full = len(committed) // ps
         kv = None
+        stash = self._handoff_stash.pop(req.request_id, None)
         if n_full:
             slot = self._slots[sid]
-            ids = np.asarray(slot.pages[:n_full])
-            gathered = {name: pool[:, ids]
-                        for name, pool in self.state["pools"].items()}
-            draft = self.state.get("draft_pools")
-            if draft is not None:
-                for name, pool in draft.items():
-                    gathered["draft/" + name] = pool[:, ids]
+            page_ids = list(slot.pages[:n_full])
+            # handoff prefetch (see _handoff_prefetch): pages gathered
+            # before the final prefill chunk's dispatch, host copies
+            # already overlapped under its compute. Valid only while
+            # they are still a PREFIX of the slot's chain (a
+            # preemption/re-admission in between re-keys the pages —
+            # the stash is then stale and the full gather below pays
+            # the whole transfer, a missed optimization, never a
+            # correctness event).
+            pre: dict = {}
+            n_pre = 0
+            if stash is not None:
+                sids_, gathers = stash
+                if list(sids_) == page_ids[:len(sids_)]:
+                    pre, n_pre = gathers, len(sids_)
+            gathered: dict = {}
+            if n_pre < n_full:
+                rem = np.asarray(page_ids[n_pre:])
+                for name, pool in self.state["pools"].items():
+                    gathered[name] = pool[:, rem]
+                draft = self.state.get("draft_pools")
+                if draft is not None:
+                    for name, pool in draft.items():
+                        gathered["draft/" + name] = pool[:, rem]
             # analysis: allow[lock-discipline] the migration export's
             # ONE sanctioned host sync — at the commit point, off the
             # plan path (DD5), under the step lock that serializes
             # the scheduler by design (analysis/dispatch.py
-            # SANCTIONED_SYNCS)
-            kv = jax.device_get(gathered)
+            # SANCTIONED_SYNCS). The prefetched half completes
+            # instantly (its D2H copy already ran under the final
+            # prefill chunk); only the remainder pays transfer here.
+            pre_h, rem_h = jax.device_get((pre, gathered))
+            if not rem_h:
+                kv = pre_h
+            elif not pre_h:
+                kv = rem_h
+            else:
+                kv = {name: np.concatenate((pre_h[name], rem_h[name]),
+                                           axis=1)
+                      for name in rem_h}
         return (self._build_snapshot(req, reason,
                                      committed[:n_full * ps], kv),
                 sid, committed)

@@ -404,7 +404,8 @@ class TenantRegistry:
 
     # -- submit gate (differentiated backpressure) --------------------------
 
-    def gate_submit(self, tenant: str | None, prompt_tokens: int) -> None:
+    def gate_submit(self, tenant: str | None, prompt_tokens: int,
+                    charge_tokens: int | None = None) -> None:
         """Admit-or-429 for one submit, called under the server lock
         AFTER the global checks: per-tenant pending bound, then the
         prompt token bucket. On success the tenant's pending count and
@@ -412,13 +413,23 @@ class TenantRegistry:
         caller performs next. A prompt LARGER than the bucket's burst
         capacity could never be admitted no matter how long the client
         waits, so it raises ValueError (HTTP 400, terminal) instead of
-        the retryable 429."""
+        the retryable 429.
+
+        `charge_tokens` overrides how many tokens the prompt bucket is
+        billed (default: the full `prompt_tokens`). A migration
+        continuation passes 0: the source replica already billed the
+        original prompt, and the salvaged generated tokens were never
+        prompt tokens — re-billing either would double-charge the
+        tenant fleet-wide for one request. The burst-capacity 400
+        keys off the same charge: a continuation's already-paid-for
+        prompt must never be refused outright."""
         tenant = self.resolve(tenant)
         st = self._state(tenant)
+        charge = prompt_tokens if charge_tokens is None else charge_tokens
         if (st.prompt_bucket is not None
-                and prompt_tokens > st.prompt_bucket.burst):
+                and charge > st.prompt_bucket.burst):
             raise ValueError(
-                f"prompt of {prompt_tokens} tokens exceeds tenant "
+                f"prompt of {charge} tokens exceeds tenant "
                 f"{tenant!r}'s burst capacity "
                 f"({st.prompt_bucket.burst:g} tokens); no retry can "
                 "ever admit it")
@@ -430,15 +441,15 @@ class TenantRegistry:
                     f"tenant {tenant!r} pending queue is full "
                     f"({bound} requests); retry later",
                     tenant=tenant,
-                    retry_after_s=self._retry_hint(st, prompt_tokens))
+                    retry_after_s=self._retry_hint(st, charge))
             if (st.prompt_bucket is not None
-                    and not st.prompt_bucket.try_consume(prompt_tokens)):
+                    and not st.prompt_bucket.try_consume(charge)):
                 st.rejected += 1
                 raise TenantQueueFullError(
                     f"tenant {tenant!r} is over its prompt-token rate "
                     "limit; retry later", tenant=tenant,
                     retry_after_s=st.prompt_bucket.retry_after(
-                        prompt_tokens))
+                        charge))
             st.pending += 1
             st.submitted += 1
 

@@ -60,7 +60,8 @@ import threading
 import uuid
 
 # The contiguous, gap-free lifecycle phases `request_phases` derives.
-PHASES = ("queue", "prefill", "decode", "preempt_gap", "emit")
+PHASES = ("queue", "prefill", "decode", "preempt_gap", "migrate_gap",
+          "emit")
 
 TRACEPARENT_HEADER = "traceparent"
 _FLAG_SAMPLED = 0x01
@@ -414,3 +415,52 @@ def resolve_recorder(tracing, sample_rate: float = 0.0
     if rate <= 0.0:
         return None
     return TraceRecorder(sample_rate=rate)
+
+
+def merge_handoff_trees(trees: list[dict]) -> list[dict]:
+    """Stitch disaggregation handoffs into ONE spanning tree per
+    request.  A handed-off request leaves two partial trees sharing a
+    trace id: the prefill replica's half (closed by finish:migrated)
+    and the decode continuation, whose root carries
+    ``handoff_of=<original request id>``.  This grafts each
+    continuation's spans onto its original's tree with a bridging
+    ``migrate_gap`` phase covering the export -> re-admission seam, so
+    the merged tree partitions [submit, finish] with no holes across
+    replicas.  Failover trees (``retry_of`` / ``migrate_of``) are left
+    untouched — operators rely on seeing those as distinct attempts.
+    Order-preserving no-op when nothing was handed off.  Trees are
+    mutated in place; callers pass freshly built dicts."""
+    by_id = {t["request_id"]: t for t in trees}
+    segments = [t for t in trees
+                if t["root"]["tags"].get("handoff_of") in by_id]
+    if not segments:
+        return trees
+    consumed: set[int] = set()
+    # Oldest-first so a (rare) chained hop grafts onto the tree its
+    # predecessor already merged into.
+    for seg in sorted(segments, key=lambda t: t["root"]["start"]):
+        base = by_id.get(seg["root"]["tags"]["handoff_of"])
+        if (base is None or base is seg
+                or base["trace_id"] != seg["trace_id"]):
+            continue
+        b_root, s_root = base["root"], seg["root"]
+        if (b_root["end"] is not None
+                and s_root["start"] >= b_root["end"]):
+            b_root["children"].append({
+                "name": "migrate_gap", "start": b_root["end"],
+                "end": s_root["start"], "tags": {"reason": "handoff"},
+                "children": []})
+        b_root["children"].extend(s_root["children"])
+        b_root["end"] = s_root["end"]
+        tags, s_tags = b_root["tags"], s_root["tags"]
+        for k, v in s_tags.items():
+            if k not in ("handoff_of", "replica"):
+                tags[k] = v
+        if "replica" in s_tags:
+            tags["decode_replica"] = s_tags["replica"]
+        segs = list(tags.get("handoff_segments", ()))
+        segs.append(seg["request_id"])
+        tags["handoff_segments"] = segs
+        consumed.add(id(seg))
+        by_id[seg["request_id"]] = base
+    return [t for t in trees if id(t) not in consumed]

@@ -38,6 +38,7 @@ from __future__ import annotations
 import inspect
 import itertools
 import logging
+import queue
 import threading
 import time
 import zlib
@@ -60,6 +61,17 @@ BREAKER_HALF_OPEN = "half_open"
 
 _BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1,
                   BREAKER_OPEN: 2}
+
+# Replica roles for disaggregated prefill/decode serving. colocated =
+# today's behavior (every replica admits and decodes); prefill = new
+# admissions chunk-prefill here, then interactive requests hand off;
+# decode = handoff destinations, pinned low-latency decode. A fleet is
+# DISAGGREGATED only when it has at least one prefill AND one decode
+# replica — any other role mix degrades to colocated placement.
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_COLOCATED = "colocated"
+_VALID_ROLES = frozenset({ROLE_PREFILL, ROLE_DECODE, ROLE_COLOCATED})
 
 
 class _Breaker:
@@ -122,7 +134,8 @@ class ReplicatedRouter:
 
     def __init__(self, replicas: Sequence, *,
                  breaker_threshold: int = 3,
-                 breaker_reset_s: float = 30.0):
+                 breaker_reset_s: float = 30.0,
+                 roles: Sequence[str] | None = None):
         if not replicas:
             raise ValueError("need at least one replica")
         if breaker_threshold < 1:
@@ -130,6 +143,33 @@ class ReplicatedRouter:
         if breaker_reset_s <= 0:
             raise ValueError("breaker_reset_s must be > 0")
         self.replicas = list(replicas)
+        # disaggregated prefill/decode roles (docs/serving.md): None —
+        # the default — means every replica is colocated and every
+        # placement/handoff path below short-circuits, byte-identical
+        # to the role-less router (pinned by the existing exact-output
+        # and dispatch-count guard tests).
+        if roles is None:
+            self.roles = [ROLE_COLOCATED] * len(self.replicas)
+        else:
+            self.roles = [str(r) for r in roles]
+            if len(self.roles) != len(self.replicas):
+                raise ValueError(
+                    f"roles has {len(self.roles)} entries for "
+                    f"{len(self.replicas)} replicas")
+            bad = set(self.roles) - _VALID_ROLES
+            if bad:
+                raise ValueError(
+                    f"unknown replica roles {sorted(bad)}; valid: "
+                    f"{sorted(_VALID_ROLES)}")
+        self._disagg = (ROLE_PREFILL in self.roles
+                        and ROLE_DECODE in self.roles)
+        if (any(r != ROLE_COLOCATED for r in self.roles)
+                and not self._disagg):
+            raise ValueError(
+                "a role-specialized fleet needs at least one "
+                "'prefill' AND one 'decode' replica (got "
+                f"{self.roles}); use all-'colocated' (or roles=None) "
+                "for a uniform fleet")
         self._rr = itertools.count()
         self._lock = threading.Lock()
         # submits picked but not yet visible in their replica's pending
@@ -179,11 +219,38 @@ class ReplicatedRouter:
             "router_drainless_stops_total",
             "stop(drain=...) calls that fell back to a drain-less "
             "replica stop() (replica without drain support)")
+        # disaggregation handoff counters (zeros unless a role-
+        # specialized fleet runs): attempts, continuations admitted on
+        # a decode replica, and the admission-to-admission latency.
+        # Registered EAGERLY so the families exist for the docs drift
+        # check whether or not a handoff ever runs.
+        self._m_handoffs = reg.counter(
+            "router_handoffs_total",
+            "Disaggregation handoffs attempted (prefill-complete "
+            "requests offered to a decode replica)")
+        self._m_handoff_success = reg.counter(
+            "router_handoff_success_total",
+            "Disaggregation handoffs whose continuation was admitted "
+            "on a decode replica")
+        self._handoff_ms = reg.histogram(
+            "router_handoff_ms",
+            "Disaggregation handoff latency (prefill completion "
+            "through destination re-admission), ms",
+            buckets=(1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                     500.0, 1000.0, 2500.0, 5000.0))
         for i in range(len(self.replicas)):
             reg.gauge("router_breaker_state",
                       "Per-replica breaker state (0 closed, 1 "
                       "half_open, 2 open)",
                       labels={"replica": str(i)})
+            # the fleet's role map as a labeled constant gauge, so
+            # per-role splits of any replica-tagged series are
+            # readable from one scrape
+            reg.gauge("router_replica_role",
+                      "Replica role assignment (constant 1; the role "
+                      "rides the labels)",
+                      labels={"replica": str(i),
+                              "role": self.roles[i]}).set(1)
         reg.add_collector(self._collect_router_metrics)
         # can each replica's submit() carry the failover hook?
         # (our servers take `fail_handler=`; third-party backends
@@ -191,14 +258,30 @@ class ReplicatedRouter:
         # behavior instead of TypeError-ing every submit)
         self._accepts_hook = [self._submit_takes_hook(r)
                               for r in self.replicas]
+        self._accepts_handoff = [self._submit_takes_hook(r, "handoff")
+                                 for r in self.replicas]
+        # disaggregation handoff plumbing: a prefill replica fires the
+        # submit-time handoff callback (outside its step lock) when a
+        # request's chunked prefill completes; the callback enqueues
+        # here and ONE daemon worker migrates request-by-request — a
+        # flood of simultaneous completions must not mint a thread
+        # each. Colocated fleets never start the worker.
+        self._handoff_q: "queue.SimpleQueue | None" = None
+        self._handoff_thread: threading.Thread | None = None
+        if self._disagg:
+            self._handoff_q = queue.SimpleQueue()
+            self._handoff_thread = threading.Thread(
+                target=self._handoff_worker, daemon=True,
+                name="router-handoff")
+            self._handoff_thread.start()
 
     @staticmethod
-    def _submit_takes_hook(replica) -> bool:
+    def _submit_takes_hook(replica, kwarg: str = "fail_handler") -> bool:
         try:
             params = inspect.signature(replica.submit).parameters
         except (TypeError, ValueError):
             return False
-        return ("fail_handler" in params
+        return (kwarg in params
                 or any(p.kind == p.VAR_KEYWORD
                        for p in params.values()))
 
@@ -235,13 +318,62 @@ class ReplicatedRouter:
             b.probing = False
         return not b.probing
 
+    @staticmethod
+    def _prefill_load(replica) -> int:
+        """Placement load for a PREFILL pick: queued prompt work, not
+        occupied decode slots. Our servers expose the exact figure
+        (pending prefill tokens); a backend without it degrades to the
+        generic request count."""
+        n = getattr(replica, "pending_prefill_tokens", None)
+        return (replica.num_active + replica.num_pending
+                if n is None else int(n))
+
+    def _role_candidates(self, cands: list[int],
+                         role: str | None) -> list[int]:
+        """Narrow a candidate set to replicas of `role` — but NEVER to
+        empty: when no replica of the wanted role is healthy (open
+        breakers, drains), placement falls back to whatever is, so a
+        role-specialized fleet degrades to colocated behavior instead
+        of refusing work."""
+        if role is None or not self._disagg:
+            return cands
+        pref = [j for j in cands if self.roles[j] == role]
+        return pref or cands
+
+    def _plan_roles(self, tenant: str | None) -> tuple[str | None, bool]:
+        """The disaggregation placement plan for one submit:
+        (admission role preference, arm the prefill->decode handoff?).
+        Every request ADMITS toward prefill capacity (admission cost
+        IS prefill); only interactive-class tenants hand off to a
+        decode replica afterward — batch/best_effort decode where
+        they prefilled, soaking prefill-replica slack instead of
+        polluting the low-latency decode pool."""
+        if not self._disagg:
+            return None, False
+        cls = "interactive"
+        try:
+            q = self.qos
+            if q is not None:
+                cls = q.priority_class(q.resolve(tenant))
+        except Exception:  # noqa: BLE001 — unknown tenant/backends
+            pass
+        return ROLE_PREFILL, cls == "interactive"
+
     def _pick(self, *, tenant: str | None = None,
               count_inflight: bool = False,
               exclude: frozenset | set = frozenset(),
-              strict: bool = False) -> int | None:
+              strict: bool = False,
+              role: str | None = None) -> int | None:
         n = len(self.replicas)
-        loads = [r.num_active + r.num_pending + inf
-                 for r, inf in zip(self.replicas, self._inflight)]
+        if role == ROLE_PREFILL and self._disagg:
+            # prefill picks balance by queued PROMPT tokens: decode
+            # occupancy (num_active) says nothing about how long a new
+            # prompt waits for chunk-prefill budget
+            loads = [self._prefill_load(r) + inf
+                     for r, inf in zip(self.replicas, self._inflight)]
+        else:
+            loads = [r.num_active + r.num_pending + inf
+                     for r, inf in zip(self.replicas, self._inflight)]
         if tenant is None:
             k = next(self._rr) % n
         else:
@@ -271,6 +403,9 @@ class ReplicatedRouter:
                 return None
             cands = ([j for j in range(n) if j not in exclude]
                      or list(range(n)))
+        # role preference narrows AFTER health (a healthy off-role
+        # replica beats a broken on-role one — see _role_candidates)
+        cands = self._role_candidates(cands, role)
         # least loaded; ties resolve round-robin from k
         i = min(cands, key=lambda j: (loads[j], (j - k) % n))
         b = self._breakers[i]
@@ -333,14 +468,24 @@ class ReplicatedRouter:
     def submit(self, prompt, **kw):
         t0 = time.perf_counter()
         excluded: set[int] = set()
+        role, arm_handoff = self._plan_roles(kw.get("tenant"))
         while True:
             with self._lock:
                 i = self._pick(tenant=kw.get("tenant"),
-                               count_inflight=True, exclude=excluded)
+                               count_inflight=True, exclude=excluded,
+                               role=role)
             hkw = ({"fail_handler": self._make_fail_hook(
                         i, prompt, dict(kw), frozenset(excluded),
                         None)}
                    if self._accepts_hook[i] else {})
+            if (arm_handoff and self.roles[i] == ROLE_PREFILL
+                    and self._accepts_handoff[i]
+                    and hasattr(self.replicas[i], "migrate_export")):
+                # prefill landed on a prefill replica: ride the
+                # handoff hook IN through submit (same no-install-
+                # window rule as the failover hook) so the replica
+                # pings us the moment chunked prefill completes
+                hkw["handoff"] = self._make_handoff_hook(i, dict(kw))
             try:
                 req = self.replicas[i].submit(prompt, **hkw, **kw)
             except QueueFullError:
@@ -645,6 +790,157 @@ class ReplicatedRouter:
         # could not resume anywhere: the original failure stands
         orig._done.set()
 
+    # -- disaggregation handoff ---------------------------------------------
+
+    def _make_handoff_hook(self, replica: int, kw: dict):
+        """The submit-time handoff callback a prefill replica fires
+        (outside its step lock) the moment a request's chunked
+        prefill completes and its first token streams. The hook only
+        ENQUEUES — the scheduler thread must never block on another
+        replica's admission path."""
+        def hook(req) -> None:
+            q = self._handoff_q
+            if q is not None:
+                q.put((req, replica, kw))
+        return hook
+
+    def _handoff_worker(self) -> None:
+        """Daemon loop draining the handoff queue one request at a
+        time. A handoff is an OPTIMIZATION: any exception leaves the
+        request decoding where it prefilled (or, after a successful
+        export, the loop inside _handoff_one owns re-admission)."""
+        q = self._handoff_q
+        while True:
+            item = q.get()
+            if item is None:
+                return  # stop() sentinel
+            try:
+                self._handoff_one(*item)
+            except Exception:  # noqa: BLE001 — keep draining
+                pass
+
+    def _handoff_one(self, orig, src_i: int, kw: dict) -> None:
+        """Move one prefill-complete request to a decode replica:
+        export the committed KV + host state from the prefill replica
+        (the final-chunk device->host copies were already started by
+        the scheduler's handoff prefetch, so the export's sanctioned
+        sync mostly finds them resident) and re-admit through
+        `migrate_import`. Until the export commits, the request keeps
+        decoding on the prefill replica — a missing/unhealthy decode
+        pool costs nothing. AFTER the export the request has left the
+        source, so the import loop must land it somewhere: decode
+        replicas first, any healthy replica next, the source itself
+        last (its pages are still hot in the local prefix cache)."""
+        if orig.done or orig._cancel.is_set():
+            return
+        excluded: set[int] = {src_i}
+        with self._lock:
+            now = time.monotonic()
+            has_dest = any(
+                j != src_i and self.roles[j] == ROLE_DECODE
+                and getattr(r, "ready", True)
+                and self._breaker_admits_locked(j, now)
+                and getattr(r, "migrate_import", None) is not None
+                for j, r in enumerate(self.replicas))
+        if not has_dest:
+            return  # no decode capacity: decode where it prefilled
+        t0 = time.perf_counter()
+        try:
+            snap = self.replicas[src_i].migrate_export(
+                orig, reason="handoff")
+        except Exception:  # noqa: BLE001 — finished/cancelled/mid-
+            return  # admission: the request stays local, no handoff
+        self._m_handoffs.inc()
+        deadline_s = None
+        if orig.deadline is not None:
+            remaining = orig.deadline - time.perf_counter()
+            if remaining <= 0:
+                orig.finish_reason = "error:deadline"
+                orig._done.set()
+                return
+            deadline_s = remaining
+        tr0 = getattr(orig, "trace", None)
+        trace_ctx = (None if tr0 is None
+                     else (tr0.trace_id, tr0.root_span_id, True))
+        last_resort = False
+        while True:
+            with self._lock:
+                i = self._pick(tenant=kw.get("tenant"),
+                               count_inflight=True, exclude=excluded,
+                               strict=True, role=ROLE_DECODE)
+            if i is None:
+                # nothing else healthy: land it back where it came
+                # from before giving up entirely
+                if last_resort:
+                    break
+                last_resort = True
+                with self._lock:
+                    self._inflight[src_i] += 1
+                i = src_i
+            imp = getattr(self.replicas[i], "migrate_import", None)
+            if imp is None:
+                with self._lock:
+                    self._inflight[i] -= 1
+                self._release_probe(i)
+                excluded.add(i)
+                continue
+            hook = (self._make_fail_hook(
+                        i, list(snap.prompt), dict(kw),
+                        frozenset(excluded), orig)
+                    if self._accepts_hook[i] else None)
+            try:
+                new = imp(snap, stream=kw.get("stream"),
+                          fail_handler=hook, trace_ctx=trace_ctx,
+                          deadline_s=deadline_s)
+            except Exception as exc:  # noqa: BLE001 — any refusal: next
+                with self._lock:
+                    self._inflight[i] -= 1
+                if (isinstance(exc, RuntimeError)
+                        and not isinstance(exc, QueueFullError)
+                        and getattr(self.replicas[i], "ready", True)):
+                    self._record_breaker_failure(i)
+                else:
+                    self._release_probe(i)
+                excluded.add(i)
+                continue
+            with self._lock:
+                self._inflight[i] -= 1
+            self._record_breaker_success(i)
+            # same mirroring/cancel-chain contract as _migrate_submit;
+            # _router_handoff keeps the completion off the failover-
+            # migration success counter (handoff success is counted
+            # HERE, at admission — the handoff "won" the moment the
+            # continuation is decoding on the destination)
+            new._router_orig = orig
+            new._router_migrated = True
+            new._router_handoff = True
+            new._on_done = self._mirror_retry
+            with self._lock:
+                gen = len(excluded)
+                if gen >= getattr(orig, "_router_cancel_gen", -1):
+                    orig._router_cancel_gen = gen
+                    orig._on_cancel = lambda _r, _n=new: _n.cancel()
+            if orig._cancel.is_set():
+                new.cancel()
+            tr = getattr(new, "trace", None)
+            if tr is not None:
+                tr.annotate(replica=i, handoff_of=orig.request_id)
+                tr.add_span("handoff", t0, time.perf_counter(),
+                            from_replica=src_i, replica=i,
+                            tokens_salvaged=len(snap.tokens),
+                            kv_pages=snap.n_kv_pages())
+            if i != src_i:
+                self._m_handoff_success.inc()
+            self._handoff_ms.observe(
+                (time.perf_counter() - t0) * 1e3)
+            if new.done:
+                self._mirror_retry(new)
+            return
+        # exported but nowhere to land (source included): the request
+        # cannot continue — fail the handle so waiters unblock
+        orig.finish_reason = orig.finish_reason or "error:handoff"
+        orig._done.set()
+
     def _mirror_retry(self, new) -> None:
         """Request._on_done of a retry: copy the outcome onto the
         original handle and unblock its waiters (tokens already
@@ -665,11 +961,22 @@ class ReplicatedRouter:
         orig.finish_reason = new.finish_reason
         if (new.finish_reason is not None
                 and not new.finish_reason.startswith("error")):
-            if getattr(new, "_router_migrated", False):
+            if getattr(new, "_router_handoff", False):
+                # disaggregation handoff: success already counted at
+                # import admission (router_handoff_success_total) —
+                # this completion is not a failover migration
+                pass
+            elif getattr(new, "_router_migrated", False):
                 self._m_migration_success.inc()
             else:
                 self._m_retry_success.inc()
         orig._done.set()
+        # `orig` may ITSELF be a router continuation holding the true
+        # client handle (a handed-off request drained or failed over
+        # again chains through the replica's request object) —
+        # propagate so the original submit's waiters unblock too.
+        # Idempotency per link bounds the recursion.
+        self._mirror_retry(orig)
 
     def generate(self, prompts, *, max_new_tokens=None):
         reqs = [self.submit(p, max_new_tokens=max_new_tokens)
@@ -734,11 +1041,17 @@ class ReplicatedRouter:
             for i, b in enumerate(self._breakers):
                 self._breaker_admits_locked(i, now)
                 out.append({
-                    "replica": i, "state": b.state,
+                    "replica": i, "role": self.roles[i],
+                    "state": b.state,
                     "consecutive_failures": b.failures,
                     "ready": bool(getattr(self.replicas[i], "ready",
                                           True))})
             return out
+
+    def replica_roles(self) -> list[str]:
+        """The fleet's role map, by replica index (all "colocated"
+        unless the constructor configured a disaggregated fleet)."""
+        return list(self.roles)
 
     def _collect_router_metrics(self) -> None:
         """Scrape-path mirror of breaker state into the router's own
@@ -917,19 +1230,33 @@ class ReplicatedRouter:
         """Span tree for one sampled request, wherever it ran: the
         first replica that knows the id answers, tagged with its
         replica index (router-submitted requests already carry it from
-        the router_pick span)."""
+        the router_pick span).  In a role-specialized fleet a
+        handed-off request's prefill and decode halves merge into the
+        ONE spanning tree; looking up either the original or the
+        continuation id returns that merged tree."""
+        tree = None
         for i, r in enumerate(self.replicas):
             fn = getattr(r, "lookup_trace", None)
             tree = fn(request_id) if fn is not None else None
             if tree is not None:
                 tree["root"]["tags"].setdefault("replica", i)
-                return tree
-        return None
+                break
+        if tree is None or not self._disagg:
+            return tree
+        for t in self.trace_trees():
+            tags = t["root"]["tags"]
+            if (t["request_id"] == request_id
+                    or request_id in tags.get("handoff_segments", ())):
+                return t
+        return tree
 
     def trace_trees(self, n: int | None = None) -> list[dict]:
         """FLEET-wide sampled span trees (the /traces source), each
         tagged with its replica index and ordered by root start
-        (n <= 0 means "no trees", the recorder's own rule)."""
+        (n <= 0 means "no trees", the recorder's own rule).  Handoff
+        continuations merge into their original's tree
+        (request_trace.merge_handoff_trees) so a disaggregated request
+        reads as one gap-free tree spanning both replicas."""
         if n is not None and n <= 0:
             return []
         out = []
@@ -940,6 +1267,10 @@ class ReplicatedRouter:
             for tree in fn(n):
                 tree["root"]["tags"].setdefault("replica", i)
                 out.append(tree)
+        if self._disagg:
+            from cloud_server_tpu.inference.request_trace import (
+                merge_handoff_trees)
+            out = merge_handoff_trees(out)
         out.sort(key=lambda t: t["root"]["start"])
         return out if n is None else out[-n:]
 
@@ -961,7 +1292,8 @@ class ReplicatedRouter:
         for i, r in enumerate(self.replicas):
             fn = getattr(r, "flight_window", None)
             if fn is not None:
-                out += [{"replica": i, **rec} for rec in fn(n)]
+                out += [{"replica": i, "role": self.roles[i], **rec}
+                        for rec in fn(n)]
         out.sort(key=lambda rec: rec.get("ts", 0.0))
         return out
 
@@ -1119,6 +1451,8 @@ class ReplicatedRouter:
 
     def stop(self, drain: bool = False,
              timeout: float | None = None) -> None:
+        if self._handoff_q is not None:
+            self._handoff_q.put(None)  # unblock the handoff worker
         for i, r in enumerate(self.replicas):
             try:
                 r.stop(drain=drain, timeout=timeout)

@@ -52,7 +52,13 @@
 # token exactness, drain(migrate=True), the armed-but-idle
 # dispatch-count clone, and the tier-1-sized chaos variant; the
 # 3-replica soak + speculation/grammar exactness runs are marked
-# slow). The suite is also runnable standalone:
+# slow), and tests/test_disagg.py (disaggregated prefill/decode:
+# role validation + colocated-default parity, role-aware _pick,
+# handoff e2e token exactness with the merged cross-replica span
+# tree, QoS continuation billing; the 4-replica drain-compose soak
+# and the batch-flood non-starvation e2e are marked slow) rides
+# [a-f]. The suite is also runnable
+# standalone:
 #   python -m cloud_server_tpu.analysis [--json] [--checker <id>]
 #
 # Tier-1 budget note (PR 14): the driver's one-process gate
@@ -105,6 +111,26 @@
 # green in a complete untimed run). Demoting another ~100 s to absorb
 # that worst case would push DOTS permanently below the baseline, so
 # the re-balance targets the typical speed instead.
+#
+# PR 17 re-balance: test_disagg.py's ~33 s tier-1 set measured a
+# COMPLETE green run at 842 s pytest on a ~8%-slow window — grazing
+# the 870 s wall once interpreter startup is counted (timeout fired
+# during teardown AFTER the "560 passed" summary). Three demotions
+# (~25 s, the PR-17 block at the end of tests/slow_tests.txt): the
+# disagg batch-flood non-starvation e2e (role-aware _pick + the
+# handoff e2e keep the fast coverage), the grammar slot-reuse hygiene
+# e2e (its constrained-exactness twin stays fast, its
+# preemption-survival twin was already slow),
+# test_paged_server_matches_engine_greedy[ondemand] (the [reserve]
+# twin stays fast as the core engine-parity check), and
+# test_mixed_step_dispatch_count_with_qos (the
+# test_observability dispatch/sync-count guard's [qos_cache] clone
+# runs the SAME invariant with a live multi-tenant registry and stays
+# fast). A first re-run also surfaced a race in the new disagg
+# handoff e2e — the async handoff worker losing to a short local
+# decode on a loaded box — fixed by enlarging the decode window to
+# 32 tokens (the flood-test fix), not by demotion. DOTS lands at 556
+# vs the 547 baseline.
 MARK=(-m "not slow")
 if [ "$1" = "--all" ]; then
     MARK=(); shift

@@ -655,6 +655,60 @@ def test_dispatch_overlap_roster_covers_the_async_scheduler():
             in dispatch.SANCTIONED_SYNCS[rel])
 
 
+def test_rosters_cover_disaggregation():
+    """The disaggregation surfaces ride the same gates as the paths
+    they extend: the router's role planner runs under the router lock
+    inside every pick/submit (hot-path roster), and the paged
+    server's handoff hooks run inside the scheduler iteration
+    (scheduler-loop + overlap-plan rosters)."""
+    router_quals = set(HOT_PATHS["cloud_server_tpu/inference/router.py"])
+    for needed in ("ReplicatedRouter._role_candidates",
+                   "ReplicatedRouter._prefill_load",
+                   "ReplicatedRouter._plan_roles"):
+        assert needed in router_quals, f"{needed} dropped from HOT_PATHS"
+    rel = "cloud_server_tpu/inference/paged_server.py"
+    loops = set(dispatch.SCHEDULER_LOOPS[rel])
+    for needed in ("PagedInferenceServer._handoff_prefetch",
+                   "PagedInferenceServer._drain_handoff_ready",
+                   "PagedInferenceServer.pending_prefill_tokens",
+                   "PagedInferenceServer._step_sequential"):
+        assert needed in loops, f"{needed} dropped from SCHEDULER_LOOPS"
+    assert ("PagedInferenceServer._handoff_prefetch"
+            in dispatch.OVERLAP_PLAN_FUNCS[rel]), \
+        "_handoff_prefetch dropped from the DD5 plan roster"
+
+
+def test_dispatch_overlap_export_stays_out_of_plan_reach():
+    """DD5 guards the disaggregation export: migrate_export evacuates
+    the source slot (releases pages), so it must stay unreachable
+    from the overlap plan path while a dispatch may be in flight.
+    Fixture round-trip proving the checker fires on exactly that
+    chain — and that the KV-prefetch shape the real
+    _handoff_prefetch uses (gather + copy_to_host_async, no release)
+    stays silent."""
+    src = (
+        "class S:\n"
+        "    def _release_slot(self, sid):\n"
+        "        pass\n"
+        "    def _evacuate_request_locked(self, req):\n"
+        "        self._release_slot(0)\n"
+        "    def migrate_export(self, req):\n"
+        "        self._evacuate_request_locked(req)\n"
+        "    def _handoff_prefetch(self, sel):\n"
+        "        self.migrate_export(None)\n"
+        "    def _handoff_prefetch_fine(self, sel):\n"
+        "        buf = self.kv.gather(sel)\n"
+        "        buf.copy_to_host_async()\n"
+    )
+    findings = dispatch.check_overlap_source(
+        "s.py", src, ("S._handoff_prefetch", "S._handoff_prefetch_fine"))
+    msgs = [f.message for f in findings]
+    assert any("_release_slot" in m for m in msgs), msgs
+    assert all("DD5" in m for m in msgs)
+    assert not [f for f in findings
+                if f.symbol == "S._handoff_prefetch_fine"], msgs
+
+
 # -- reporters / CLI --------------------------------------------------------
 
 def test_json_report_shape_is_stable():

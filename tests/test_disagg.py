@@ -1,0 +1,443 @@
+"""Disaggregated prefill/decode serving: role-specialized replicas
+behind the ReplicatedRouter with overlapped KV handoff.
+
+The load-bearing guarantees:
+
+  * An UNCONFIGURED fleet (no ``roles=``) is byte-identical to the
+    colocated router — no handoff worker, no role preference in
+    ``_pick``, zero movement on the handoff counters.
+  * A handed-off request's client-visible stream is byte-identical to
+    the uninterrupted lone-server run (the migration exactness
+    contract, inherited), and its span tree stays ONE gap-free tree
+    spanning prefill replica -> decode replica with a ``handoff``
+    span carrying the provenance.
+  * The handoff is an OPTIMIZATION: no healthy decode destination
+    means the request simply decodes where it prefilled.
+  * QoS continuation billing (the satellite bugfix): re-admission on
+    the destination charges ZERO prompt tokens — the source already
+    billed the prompt, and salvaged tokens were never prompt tokens.
+"""
+
+import time
+
+import jax
+import pytest
+
+from cloud_server_tpu.config import InferConfig, ModelConfig
+from cloud_server_tpu.inference.http_server import HttpFrontend
+from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+from cloud_server_tpu.inference.qos import (TenantQueueFullError,
+                                            TenantRegistry)
+from cloud_server_tpu.inference.router import (ROLE_COLOCATED,
+                                               ROLE_DECODE,
+                                               ROLE_PREFILL,
+                                               ReplicatedRouter)
+from cloud_server_tpu.models import transformer
+
+CFG = ModelConfig(
+    vocab_size=64, embed_dim=32, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=8, mlp_dim=64, max_seq_len=256, dtype="float32",
+    param_dtype="float32", remat="none")
+GREEDY = InferConfig(max_decode_len=8, temperature=0.0, eos_token_id=-1,
+                     pad_token_id=0)
+SRV_KW = dict(max_slots=4, max_context=64, page_size=8, prefill_chunk=16,
+              prompt_buckets=[16, 32])
+LONG = [(i * 7) % 60 + 1 for i in range(30)]
+MID = [3, 1, 4, 1, 5, 9, 2, 6]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.key(0))
+
+
+def _drive(router, reqs, deadline_s=90.0):
+    deadline = time.time() + deadline_s
+    while not all(r.done for r in reqs) and time.time() < deadline:
+        router.step()
+        time.sleep(0.001)
+    assert all(r.done for r in reqs), \
+        [(r.request_id, len(r.tokens), r.finish_reason) for r in reqs]
+
+
+def _counter(router, name):
+    entry = router.metrics_snapshot().get(f"cloud_server_{name}")
+    return 0.0 if entry is None else entry["value"]
+
+
+# ---------------------------------------------------------------------------
+# role plumbing: validation, colocated default, planner
+# ---------------------------------------------------------------------------
+
+
+class _Stub:
+    """Minimal replica for placement tests: load knobs, no device."""
+
+    def __init__(self, active=0, pending=0, prefill_tokens=None):
+        self.num_active = active
+        self.num_pending = pending
+        if prefill_tokens is not None:
+            self.pending_prefill_tokens = prefill_tokens
+        self.got = []
+
+    def submit(self, prompt, **kw):
+        self.got.append(prompt)
+        return prompt
+
+
+def test_role_validation():
+    with pytest.raises(ValueError, match="entries for"):
+        ReplicatedRouter([_Stub(), _Stub()], roles=["prefill"])
+    with pytest.raises(ValueError, match="unknown replica role"):
+        ReplicatedRouter([_Stub(), _Stub()],
+                         roles=["prefill", "chonk"])
+    # a role-specialized fleet needs BOTH halves: all-prefill would
+    # admit forever and decode nowhere
+    with pytest.raises(ValueError, match="prefill.*decode"):
+        ReplicatedRouter([_Stub(), _Stub()],
+                         roles=["prefill", "prefill"])
+    r = ReplicatedRouter(
+        [_Stub(), _Stub(), _Stub()],
+        roles=[ROLE_PREFILL, ROLE_COLOCATED, ROLE_DECODE])
+    assert r._disagg
+    assert r.replica_roles() == ["prefill", "colocated", "decode"]
+
+
+def test_colocated_default_has_no_disagg_machinery():
+    r = ReplicatedRouter([_Stub(), _Stub()])
+    assert r.replica_roles() == [ROLE_COLOCATED, ROLE_COLOCATED]
+    assert not r._disagg
+    assert r._handoff_thread is None and r._handoff_q is None
+    # the planner is a no-op: no role preference, nothing to arm
+    assert r._plan_roles(None) == (None, False)
+    assert r._plan_roles("anyone") == (None, False)
+    # role surfaces still report, uniformly colocated
+    assert [st["role"] for st in r.breaker_states()] == \
+        ["colocated", "colocated"]
+    # the handoff metric families exist (docs drift check needs them
+    # registered eagerly) and sit at zero
+    assert _counter(r, "router_handoffs_total") == 0
+    assert _counter(r, "router_handoff_success_total") == 0
+
+
+def test_plan_roles_by_qos_class():
+    """Interactive tenants arm the handoff; batch/best_effort decode
+    where they prefill (they soak prefill-replica slack instead of
+    polluting the low-latency decode pool)."""
+    class _Q:
+        def resolve(self, t):
+            return t or "default"
+
+        def priority_class(self, t):
+            return {"bg": "batch", "scraper": "best_effort"}.get(
+                t, "interactive")
+
+    stub0 = _Stub()
+    stub0.qos = _Q()
+    r = ReplicatedRouter([stub0, _Stub()],
+                         roles=["prefill", "decode"])
+    assert r._plan_roles("fg") == (ROLE_PREFILL, True)
+    assert r._plan_roles(None) == (ROLE_PREFILL, True)
+    assert r._plan_roles("bg") == (ROLE_PREFILL, False)
+    assert r._plan_roles("scraper") == (ROLE_PREFILL, False)
+
+
+# ---------------------------------------------------------------------------
+# role-aware _pick: prefill-token load, decode preference, fallback
+# ---------------------------------------------------------------------------
+
+
+def test_pick_prefill_balances_by_pending_prefill_tokens():
+    """Prefill picks rank by queued PROMPT tokens (a 4k-token prompt
+    is not the same backlog as a 4-token one), not request counts —
+    and new admissions avoid decode replicas entirely."""
+    # replica 0: many tiny queued prompts; replica 1: one huge one;
+    # replica 2 is the decode replica and must not take admissions
+    p0 = _Stub(active=0, pending=6, prefill_tokens=24)
+    p1 = _Stub(active=0, pending=1, prefill_tokens=900)
+    d = _Stub(active=0, pending=0, prefill_tokens=0)
+    r = ReplicatedRouter([p0, p1, d],
+                         roles=["prefill", "prefill", "decode"])
+    for _ in range(4):
+        r.submit([1, 2, 3])
+    # every admission went to the prefill replica with the SMALLER
+    # token backlog despite its larger request count; none to decode
+    assert len(p0.got) == 4 and not p1.got and not d.got
+
+    # a backend WITHOUT pending_prefill_tokens degrades to request
+    # counts instead of blowing up
+    legacy = _Stub(active=1, pending=1)
+    assert ReplicatedRouter._prefill_load(legacy) == 2
+    assert ReplicatedRouter._prefill_load(p1) == 900
+
+
+def test_pick_decode_prefers_decode_replicas():
+    p = _Stub(active=0, pending=0, prefill_tokens=0)
+    d0, d1 = _Stub(active=3), _Stub(active=1)
+    r = ReplicatedRouter([p, d0, d1],
+                         roles=["prefill", "decode", "decode"])
+    with r._lock:
+        picks = [r._pick(role=ROLE_DECODE) for _ in range(3)]
+    # least-loaded DECODE replica wins; the idle prefill replica is
+    # not a decode candidate while decode capacity is healthy
+    assert picks == [2, 2, 2]
+
+
+def test_pick_role_falls_back_past_unhealthy_role():
+    """Satellite: failover past an open breaker respects roles by
+    DEGRADING, not refusing — with every replica of the wanted role
+    unhealthy, the pick lands on any healthy replica."""
+    p, d = _Stub(), _Stub()
+    r = ReplicatedRouter([p, d], roles=["prefill", "decode"],
+                         breaker_threshold=1, breaker_reset_s=60.0)
+    r._record_breaker_failure(1)  # decode replica's breaker opens
+    assert r.breaker_states()[1]["state"] == "open"
+    with r._lock:
+        # decode pick falls back to the healthy PREFILL replica
+        assert r._pick(role=ROLE_DECODE) == 0
+    r._record_breaker_success(1)
+    r._record_breaker_failure(0)  # now the prefill breaker is open
+    with r._lock:
+        # mirror case: admissions land on the decode replica rather
+        # than refusing
+        assert r._pick(role=ROLE_PREFILL) == 1
+    r._record_breaker_failure(1)
+    with r._lock:
+        # BOTH breakers open: the non-strict pick still returns
+        # something (the everything-unhealthy fallback) — the
+        # replica's own refusal is the error surface, not an index
+        # error here
+        assert r._pick(role=ROLE_PREFILL) is not None
+
+
+# ---------------------------------------------------------------------------
+# handoff e2e: exactness, spans, counters, per-role token placement
+# ---------------------------------------------------------------------------
+
+
+def test_handoff_e2e_token_exact_and_one_tree(params):
+    """1 prefill + 1 decode replica vs a lone server: every stream is
+    byte-identical, every request's spans form ONE gap-free tree
+    spanning both replicas, and the decode replica generated the
+    tokens after the handoff."""
+    prompts = [LONG, MID, [7, 7, 2, 11, 30]]
+    # the handoff worker runs ASYNC behind the export queue; a long
+    # decode window (32 ≈ LONG fills max_context) guarantees every
+    # request is still decoding when its export lands, even on a
+    # loaded box — at 20 the shortest prompt was seen finishing
+    # locally first
+    lone = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    refs = [lone.generate([p], max_new_tokens=32)[0] for p in prompts]
+
+    rp = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW,
+                              tracing=1.0)
+    rd = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW,
+                              tracing=1.0)
+    router = ReplicatedRouter([rp, rd], roles=["prefill", "decode"])
+    streams = [[] for _ in prompts]
+    reqs = [router.submit(p, max_new_tokens=32, stream=st.append)
+            for p, st in zip(prompts, streams)]
+    _drive(router, reqs)
+
+    for r, ref, st in zip(reqs, refs, streams):
+        assert r.finish_reason == "length"
+        assert list(r.tokens) == ref
+        assert st == ref
+
+    assert _counter(router, "router_handoffs_total") == 3
+    assert _counter(router, "router_handoff_success_total") == 3
+    # both halves worked: admission+prefill tokens on the prefill
+    # replica, the post-handoff decode tail on the decode replica
+    assert rp.tokens_emitted > 0 and rd.tokens_emitted > 0
+
+    # exactly one tree per request, each spanning both replicas with
+    # a handoff span carrying the provenance
+    trees = router.trace_trees()
+    by_req = {}
+    for t in trees:
+        by_req.setdefault(t["request_id"], []).append(t)
+    spans = []
+    for r in reqs:
+        ts = by_req.get(r.request_id, [])
+        assert len(ts) == 1, f"{r.request_id}: {len(ts)} trees"
+        sp = [c for c in ts[0]["root"]["children"]
+              if c["name"] == "handoff"]
+        assert len(sp) == 1
+        spans.append(sp[0])
+    for sp in spans:
+        assert sp["tags"]["from_replica"] == 0
+        assert sp["tags"]["replica"] == 1
+        assert sp["tags"]["kv_pages"] >= 0
+    from tests.test_migration import _assert_gap_free
+    for t in trees:
+        if t["root"]["end"] is not None:
+            _assert_gap_free(t)
+
+    # satellite: role tags on every fleet-merged surface
+    assert [st["role"] for st in router.breaker_states()] == \
+        ["prefill", "decode"]
+    recs = router.flight_window(4)
+    assert recs and all(rec["role"] in ("prefill", "decode")
+                        for rec in recs)
+    payload = HttpFrontend(router)._stats_json(0)
+    assert payload["roles"] == ["prefill", "decode"]
+    snap = router.metrics_snapshot()
+    assert snap["cloud_server_router_replica_role"
+                '{replica="0",role="prefill"}']["value"] == 1
+    assert snap["cloud_server_router_replica_role"
+                '{replica="1",role="decode"}']["value"] == 1
+
+
+def test_handoff_without_decode_capacity_stays_local(params):
+    """A prefill replica paired with a decode replica that cannot
+    import (no migrate_import surface): the handoff is silently
+    skipped BEFORE the export — the request decodes where it
+    prefilled, exact, with zero handoff attempts counted."""
+    lone = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    ref = lone.generate([MID], max_new_tokens=12)[0]
+
+    rp = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    d = _Stub()  # no migrate_import: never a handoff destination
+    router = ReplicatedRouter([rp, d], roles=["prefill", "decode"])
+    req = router.submit(MID, max_new_tokens=12)
+    deadline = time.time() + 60
+    while not req.done and time.time() < deadline:
+        rp.step()
+        time.sleep(0.001)
+    assert req.done and list(req.tokens) == ref
+    assert _counter(router, "router_handoffs_total") == 0
+
+
+def test_batch_flood_decodes_on_prefill_interactive_hands_off(params):
+    """Satellite QoS-mix coverage: under a batch flood, interactive
+    requests hand off to the decode replica while the batch tenant's
+    decode stays on the prefill replica — and the flood does not
+    starve interactive admission."""
+    qos = {"tenants": {"bg": {"priority": "batch", "weight": 1.0},
+                       "fg": {"priority": "interactive",
+                              "weight": 8.0}}}
+    rp = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW,
+                              tracing=1.0, qos=qos)
+    rd = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW,
+                              tracing=1.0, qos=qos)
+    router = ReplicatedRouter([rp, rd], roles=["prefill", "decode"])
+    flood = [router.submit(LONG, max_new_tokens=10, tenant="bg")
+             for _ in range(6)]
+    # the handoff worker runs ASYNC behind the export queue; a long
+    # decode window guarantees it beats local completion even when
+    # the flood slows every step
+    fgs = [router.submit(MID, max_new_tokens=32, tenant="fg")
+           for _ in range(2)]
+    _drive(router, flood + fgs)
+    assert all(r.finish_reason == "length" for r in flood + fgs)
+
+    handoff_of = {}
+    for t in router.trace_trees():
+        for c in t["root"]["children"]:
+            if c["name"] == "handoff":
+                handoff_of[t["request_id"]] = c
+    # every interactive request moved to the decode replica...
+    assert all(r.request_id in handoff_of for r in fgs)
+    # ...and no batch request did
+    assert not any(r.request_id in handoff_of for r in flood)
+
+
+# ---------------------------------------------------------------------------
+# satellite bugfix: continuation admission must not re-bill prompt
+# tokens against the destination tenant's QoS prompt bucket
+# ---------------------------------------------------------------------------
+
+
+def test_gate_submit_charge_tokens_override():
+    reg = TenantRegistry({"tenants": {
+        "t": {"prompt_tokens_per_s": 1.0, "prompt_burst": 40.0,
+              "max_pending": 4}}})
+    lvl0 = reg._state("t").prompt_bucket.level()
+    # a continuation admission charges ZERO prompt tokens — even when
+    # the full continuation prompt (prompt + salvaged tokens) exceeds
+    # the bucket's burst, because the burst guard keys off the CHARGE
+    reg.gate_submit("t", 100, charge_tokens=0)
+    assert reg._state("t").prompt_bucket.level() == \
+        pytest.approx(lvl0, abs=1e-3)
+    # the default path still bills (and still enforces burst)
+    reg.gate_submit("t", 10)
+    assert reg._state("t").prompt_bucket.level() == \
+        pytest.approx(lvl0 - 10, abs=1e-3)
+    with pytest.raises(ValueError, match="burst"):
+        reg.gate_submit("t", 100)
+    # charge_tokens only overrides the BILLING; max_pending still
+    # bounds continuations like any admission
+    reg.gate_submit("t", 5, charge_tokens=0)
+    reg.gate_submit("t", 5, charge_tokens=0)
+    with pytest.raises(TenantQueueFullError):
+        reg.gate_submit("t", 5, charge_tokens=0)
+
+
+def test_handoff_bills_prompt_tokens_exactly_once(params):
+    """Fleet-merged tenant accounting of a handed-off request matches
+    the uninterrupted run: the prompt bucket is debited len(prompt)
+    total across BOTH replicas (the destination charges zero), where
+    the pre-fix behavior double-billed prompt + salvaged tokens on
+    the destination."""
+    qos = {"tenants": {"t": {
+        "prompt_tokens_per_s": 0.001,  # negligible refill
+        "prompt_burst": 400.0}}}
+    rp = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW, qos=qos)
+    rd = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW, qos=qos)
+    router = ReplicatedRouter([rp, rd], roles=["prefill", "decode"])
+    # long decode window so the async export always beats local
+    # completion (see test_handoff_e2e_token_exact_and_one_tree)
+    req = router.submit(LONG, max_new_tokens=32, tenant="t")
+    _drive(router, [req])
+    assert req.finish_reason == "length"
+    assert _counter(router, "router_handoff_success_total") == 1
+
+    spent = sum(400.0 - srv.qos._state("t").prompt_bucket.level()
+                for srv in (rp, rd))
+    assert spent == pytest.approx(len(LONG), abs=0.5)
+    # the continuation admission still COUNTS as a submit on the
+    # destination (fleet submitted = 2), it just doesn't re-bill
+    assert router.tenant_stats()["t"]["submitted"] == 2
+
+
+def test_disagg_soak_mixed_fleet_with_drain(params):
+    """SLOW e2e soak: a 4-replica mixed fleet (2 prefill + 2 decode)
+    under an interactive+batch mix, with one decode replica DRAINED
+    mid-run — handoff and drain-migration compose: requests that
+    handed off to the draining replica move AGAIN to a surviving
+    replica, everything finishes by length, new handoffs route around
+    the drained replica, and the fleet's trace surfaces stay
+    consistent (one tree per original request id; no unmerged handoff
+    continuation leaks)."""
+    qos = {"tenants": {"fg": {"priority": "interactive", "weight": 4.0},
+                       "bg": {"priority": "batch", "weight": 1.0}}}
+    srvs = [PagedInferenceServer(params, CFG, GREEDY, **SRV_KW,
+                                 tracing=1.0, qos=qos)
+            for _ in range(4)]
+    router = ReplicatedRouter(
+        srvs, roles=["prefill", "prefill", "decode", "decode"])
+    try:
+        bgs = [router.submit(LONG, max_new_tokens=6, tenant="bg")
+               for _ in range(6)]
+        fgs = [router.submit(MID, max_new_tokens=32, tenant="fg")
+               for _ in range(8)]
+        for _ in range(6):
+            router.step()
+        router.drain(2, migrate=True)
+        _drive(router, bgs + fgs, deadline_s=120)
+        assert all(r.finish_reason == "length" for r in bgs + fgs)
+        assert _counter(router, "router_handoff_success_total") >= 1
+        trees = router.trace_trees()
+        by_id = {}
+        for t in trees:
+            by_id.setdefault(t["request_id"], []).append(t)
+        for r in bgs + fgs:
+            assert len(by_id.get(r.request_id, ())) == 1, r.request_id
+        assert not [t for t in trees
+                    if t["root"]["tags"].get("handoff_of")], \
+            "unmerged handoff continuation leaked"
+        # drained replica is out of rotation and empty
+        assert not router.breaker_states()[2]["ready"]
+        assert srvs[2].num_active == 0 and srvs[2].num_pending == 0
+    finally:
+        router.stop()
