@@ -398,6 +398,15 @@ def serving_bench():
         print(f"[serving_bench] disagg_vs_colocated skipped after "
               f"error: {exc!r}", flush=True)
         out["disagg_vs_colocated_error"] = repr(exc)[:160]
+    # anomaly watchdog + tail retention under an injected-fault flood
+    # (same guard discipline)
+    try:
+        out.update(_anomaly_forensics_bench(params_bf16, base,
+                                            infer_cfg))
+    except Exception as exc:  # noqa: BLE001
+        print(f"[serving_bench] anomaly_forensics skipped after "
+              f"error: {exc!r}", flush=True)
+        out["anomaly_forensics_error"] = repr(exc)[:160]
     return out
 
 
@@ -542,6 +551,154 @@ def _fault_recovery_bench(params, base, infer_cfg):
                  f"{res['migration_ms_p50']:.1f} ms, salvaged "
                  f"{res['tokens_salvaged_frac']:.2f})"
                  if inject else ""), flush=True)
+    return out
+
+
+def _anomaly_forensics_bench(params, base, infer_cfg):
+    """Anomaly watchdog + tail retention + forensic bundles under a
+    churn flood with injected faults (docs/observability.md "Anomaly
+    detection & forensics"), at trace_sample_rate=0.01 — the
+    production-shaped sampling where head sampling alone would lose
+    ~99% of broken requests' traces:
+
+      * three incident rounds: each arms an `iteration_stall` fault
+        (faults.py — the scheduler stalls mid-iteration) and lands a
+        burst of deadline-doomed requests; `anomaly_detect_ms_p50` is
+        the wall time from the burst to the watchdog's activation
+        edge (`deadline_spike` latching), per round;
+      * `bundle_on_anomaly` auto-captures a forensic bundle on each
+        edge — asserted captured, carrying the covering flight
+        window;
+      * `churn_tail_traces_retained_frac` — the fraction of broken
+        (deadline-expired) requests whose span trees survived at 1%
+        head sampling via tail retention, asserted 1.0 with every
+        retained tree gap-free (phase spans contiguous)."""
+    import dataclasses
+
+    import numpy as np
+
+    from cloud_server_tpu.inference.faults import FaultPlan
+    from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+    from cloud_server_tpu.inference.request_trace import PHASES
+
+    cfg = dataclasses.replace(base, decode_attention_impl="pallas")
+    icfg = dataclasses.replace(
+        infer_cfg, trace_sample_rate=0.01, trace_tail_capacity=256,
+        bundle_on_anomaly=True)
+    # short windows so each incident round opens (and closes) its OWN
+    # anomaly window: three distinct activation edges, three bundles
+    anomaly_cfg = {"warmup": 0, "check_every": 1, "hold_s": 0.25,
+                   "rules": {"deadline_spike":
+                             {"count": 3, "window_s": 1.0}}}
+    fp = FaultPlan()
+    srv = PagedInferenceServer(
+        params, cfg, icfg, max_slots=16, max_context=1024,
+        page_size=128, prefill_chunk=256, decode_chunk=8,
+        prompt_buckets=[64, 256], scheduler="mixed",
+        anomaly=anomaly_cfg, faults=fp)
+    rng = np.random.RandomState(0)
+
+    def mk_prompt(n):
+        return [int(x) for x in rng.randint(1, 30000, size=n)]
+
+    def top_up():
+        # the watchdog only observes BUSY iterations, so keep the
+        # scheduler fed (window close needs observed time to pass)
+        if not (srv._jobs or srv.num_pending or srv.num_active):
+            srv.submit(mk_prompt(64), max_new_tokens=256)
+
+    # background churn flood at 1% head sampling; a few steps compile
+    # every shape before the timed incident rounds
+    flood = [srv.submit(mk_prompt(64), max_new_tokens=256)
+             for _ in range(8)]
+    for _ in range(4):
+        srv.step()
+
+    detect_ms = []
+    detect_steps = []
+    doomed = []
+    fired_seen = 0
+    for _ in range(3):
+        fp.arm("iteration_stall", count=2, stall_ms=120.0)
+        doomed_batch = [srv.submit(mk_prompt(64), max_new_tokens=64,
+                                   deadline_s=1e-3) for _ in range(3)]
+        doomed += doomed_batch
+        t0 = time.perf_counter()
+        steps = 0
+        while time.perf_counter() - t0 < 60.0:
+            top_up()
+            srv.step()
+            steps += 1
+            fired = sum(srv.anomaly_stats()["fired_total"].values())
+            if fired > fired_seen:
+                fired_seen = fired
+                detect_ms.append((time.perf_counter() - t0) * 1e3)
+                detect_steps.append(steps)
+                break
+        # step the open window shut before the next round (prune past
+        # window_s, then hold_s of recovery)
+        t_close = time.perf_counter()
+        while (srv.anomaly_stats()["active"]
+               and time.perf_counter() - t_close < 60.0):
+            top_up()
+            srv.step()
+    assert len(detect_ms) == 3, (
+        f"watchdog latched {len(detect_ms)}/3 incident rounds")
+    assert max(detect_steps) <= 50, (
+        f"detection took {max(detect_steps)} iterations — not bounded")
+    srv.run_until_idle()
+
+    # injected fault really fired, bundles auto-captured on each edge
+    # with the covering flight window
+    fstats = srv.fault_stats()
+    assert fstats["fired"]["iteration_stall"] >= 1, fstats["fired"]
+    bundles = srv.debug_bundles()
+    assert len(bundles) == 3, f"{len(bundles)} bundles for 3 edges"
+    for b in bundles:
+        assert b["trigger"] == "anomaly:deadline_spike"
+        assert b["flight"], "bundle missing the covering flight window"
+        assert b["anomaly"]["active"], "bundle missed the open window"
+
+    # 100% of broken requests kept a gap-free tree at 1% head sampling
+    # (lookup spans the head ring AND the tail ring — a doomed request
+    # that happened to be head-sampled counts too)
+    retained = 0
+    for r in doomed:
+        assert r.finish_reason == "deadline", r.finish_reason
+        tree = srv.lookup_trace(r.request_id)
+        if tree is None:
+            continue
+        retained += 1
+        root = tree["root"]
+        assert root["start"] == r.submit_time
+        assert root["end"] is not None
+        phases = [c for c in root["children"] if c["name"] in PHASES]
+        assert phases[0]["start"] == root["start"]
+        for a, b in zip(phases, phases[1:]):
+            assert a["end"] == b["start"], \
+                f"gap between {a['name']} and {b['name']}"
+        assert phases[-1]["end"] == root["end"]
+    frac = retained / len(doomed)
+    assert frac == 1.0, (
+        f"only {retained}/{len(doomed)} broken requests kept a tree")
+    tstats = srv.tail_trace_stats()
+    srv.stop()
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    out = {"churn_tail_traces_retained_frac": frac,
+           "anomaly_detect_ms_p50": pct(detect_ms, 0.50),
+           "anomaly_detect_iters_max": max(detect_steps),
+           "anomaly_bundles_captured": len(bundles),
+           "anomaly_tail_retained_total":
+               sum(tstats["retained_total"].values())}
+    print(f"[serving_bench] anomaly_forensics: detect p50 "
+          f"{out['anomaly_detect_ms_p50']:.1f} ms "
+          f"(<= {out['anomaly_detect_iters_max']} iters), "
+          f"{len(bundles)} bundles, tail retained frac {frac:.2f}",
+          flush=True)
     return out
 
 

@@ -229,6 +229,7 @@ HOST_POLICY_MODULES: tuple[str, ...] = (
     "cloud_server_tpu/inference/spec_control.py",
     "cloud_server_tpu/inference/iteration_profile.py",
     "cloud_server_tpu/inference/cache_telemetry.py",
+    "cloud_server_tpu/inference/anomaly.py",
     "cloud_server_tpu/utils/serving_metrics.py",
 )
 

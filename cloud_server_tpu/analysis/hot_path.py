@@ -60,6 +60,9 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "TraceRecorder.should_sample",
         "TraceRecorder.begin",
         "TraceRecorder.finish",
+        # tail-retention verdict: runs inside finish() at every
+        # completion that carries a (head or provisional) trace
+        "TraceRecorder._tail_reason",
     ),
     # iteration-phase profiler: begin/mark run at every phase
     # boundary of every scheduler iteration (the tightest loop this
@@ -107,12 +110,28 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "OverloadDetector.retry_hint",
     ),
     # SLO tracking: observe() runs at admit / first-token / emit /
-    # finish host moments; report/mirror are scrape-path only
+    # finish host moments; report/mirror are scrape-path only.
+    # exceeds_target feeds the tail-retention verdict at every
+    # completion.
     "cloud_server_tpu/inference/slo.py": (
         "ClassSLO.target",
         "_RollingCounts.observe",
         "SLOTracker.resolve_class",
         "SLOTracker.observe",
+        "SLOTracker.exceeds_target",
+    ),
+    # anomaly watchdog: observe_iteration runs once per busy
+    # scheduler iteration and observe_request at every completion —
+    # both on caller-passed clocks (zero clock reads of their own);
+    # active_count gates the tail-retention verdict at completion.
+    # The read paths (stats / events / merge_anomaly_stats) are
+    # scrape-path only and deliberately absent.
+    "cloud_server_tpu/inference/anomaly.py": (
+        "AnomalyWatchdog.observe_iteration",
+        "AnomalyWatchdog.observe_request",
+        "AnomalyWatchdog.active_count",
+        "AnomalyWatchdog._update_rule",
+        "AnomalyWatchdog._shift",
     ),
     # adaptive speculation control: planning (draft_len) and feedback
     # (observe / on_plain_dispatch) run once per dispatch / committed

@@ -80,6 +80,7 @@ LOCK_ROSTER: tuple[str, ...] = (
     "cloud_server_tpu/inference/request_trace.py",
     "cloud_server_tpu/inference/slo.py",
     "cloud_server_tpu/inference/cache_telemetry.py",
+    "cloud_server_tpu/inference/anomaly.py",
 )
 
 # Declared acquisition order, outermost first: the scheduler iteration

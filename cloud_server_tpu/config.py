@@ -236,6 +236,22 @@ class InferConfig:
     # headers propagate in and out. Constructor argument `tracing=`
     # (a rate or a ready TraceRecorder) overrides.
     trace_sample_rate: float = 0.0
+    # Finished-trace ring capacity: how many completed head-sampled
+    # span trees the recorder retains for GET /traces and
+    # GET /debug/requests/<id> (oldest evicted). Previously hardcoded
+    # at 256 inside the recorder. Constructor argument `tracing=` with
+    # a ready TraceRecorder overrides.
+    trace_capacity: int = 256
+    # Tail-based trace retention: capacity of the SEPARATE bounded
+    # ring that keeps the span trees of requests that proved anomalous
+    # at finish (failed / deadline-expired / cancelled, migrated or
+    # retried, missed their class SLO target, preempted repeatedly, or
+    # finished inside an open anomaly window) even when head sampling
+    # skipped them — the "1% sampling, broken requests always
+    # inspectable" mode. 0 (the default) disables tail retention
+    # entirely (no provisional traces, byte-identical pre-tail
+    # serving).
+    trace_tail_capacity: int = 0
     # Adaptive speculative decoding (inference/spec_control.py): a JSON
     # object as a string, or a path to a JSON file, with the controller
     # knobs (low/high accept-rate hysteresis thresholds, ewma, cooldown,
@@ -289,6 +305,21 @@ class InferConfig:
     # QoS registry (shed sets are priority classes). Paged server
     # only; constructor argument `brownout=` overrides.
     brownout_config: str = ""
+    # Anomaly watchdog (inference/anomaly.py): a JSON object as a
+    # string, or a path to a JSON file, with the rule thresholds
+    # (slo_burn / latency_shift / cache_collapse / breaker_flap /
+    # deadline_spike / preempt_spike / host_gap / wedged), hysteresis
+    # hold, warm-up, and optional auto-capture knobs (schema in the
+    # module docstring). "" (the default) disables the watchdog
+    # entirely: every guarded call site short-circuits and the
+    # schedulers run the byte-identical pre-watchdog paths.
+    # Constructor argument `anomaly=` overrides.
+    anomaly_config: str = ""
+    # Auto-capture a forensic debug bundle (the GET /debug/bundle
+    # artifact: metrics, flight window, retained traces, cache/SLO/
+    # brownout/anomaly state) into a bounded ring each time a watchdog
+    # rule activates. Requires anomaly_config; off by default.
+    bundle_on_anomaly: bool = False
 
     def __post_init__(self) -> None:
         if self.scheduler not in ("mixed", "alternating"):
@@ -297,6 +328,10 @@ class InferConfig:
             raise ValueError("flight_recorder_size must be positive")
         if not 0.0 <= self.trace_sample_rate <= 1.0:
             raise ValueError("trace_sample_rate must be in [0, 1]")
+        if self.trace_capacity <= 0:
+            raise ValueError("trace_capacity must be positive")
+        if self.trace_tail_capacity < 0:
+            raise ValueError("trace_tail_capacity must be >= 0")
 
 
 def to_json(cfg: Any) -> str:

@@ -210,7 +210,41 @@ def build_parser() -> argparse.ArgumentParser:
                    "sampling probability in [0, 1]. Sampled requests "
                    "carry span trees (GET /debug/requests/<id>, "
                    "Perfetto export via GET /traces, W3C traceparent "
-                   "in/out). 0 (default) disables tracing entirely")
+                   "in/out). 0 (default) disables tracing entirely "
+                   "(unless --trace-tail-capacity keeps the recorder "
+                   "alive for tail retention)")
+    p.add_argument("--trace-capacity", type=int, default=256,
+                   metavar="N",
+                   help="finished-trace ring size: how many completed "
+                   "head-sampled span trees stay inspectable "
+                   "(GET /traces; default 256)")
+    p.add_argument("--trace-tail-capacity", type=int, default=0,
+                   metavar="N",
+                   help="tail-based trace retention: keep up to N span "
+                   "trees of ANOMALOUS head-unsampled requests "
+                   "(failed / deadline-expired / cancelled / migrated "
+                   "/ SLO-violating / repeatedly-preempted / finished "
+                   "inside an open anomaly window) in a separate ring. "
+                   "Works at any --trace-sample-rate, including 0 — "
+                   "e.g. 1%% head sampling plus a tail ring means "
+                   "broken requests are ALWAYS inspectable. 0 "
+                   "(default) disables tail retention")
+    p.add_argument("--anomaly-config", metavar="FILE_OR_JSON",
+                   default=None,
+                   help="anomaly watchdog (inference/anomaly.py): a "
+                   "JSON file path (or inline JSON object) tuning the "
+                   "rule thresholds (SLO burn rate, TTFT/ITL EWMA "
+                   "shift, cache hit-rate collapse, breaker flaps, "
+                   "deadline/preemption spikes, host-gap regression, "
+                   "wedged scheduler), hysteresis hold, and the "
+                   "optional capture_iters/capture_dir auto "
+                   "/debug/trace arm. {} enables every rule at "
+                   "defaults")
+    p.add_argument("--bundle-on-anomaly", action="store_true",
+                   help="auto-capture a forensic debug bundle "
+                   "(GET /debug/bundle schema) into a bounded ring "
+                   "each time a watchdog rule fires (needs "
+                   "--anomaly-config)")
     p.add_argument("--no-iteration-profile", action="store_true",
                    help="disable the iteration-phase profiler (on by "
                    "default: per-iteration sweep/admission/build/"
@@ -371,7 +405,10 @@ def main(argv=None) -> None:
         max_decode_len=args.max_new, temperature=args.temperature,
         top_k=args.top_k, top_p=args.top_p,
         eos_token_id=tok.eos_id if tok.eos_id is not None else -1,
-        pad_token_id=tok.pad_id or 0)
+        pad_token_id=tok.pad_id or 0,
+        trace_capacity=args.trace_capacity,
+        trace_tail_capacity=args.trace_tail_capacity,
+        bundle_on_anomaly=args.bundle_on_anomaly)
 
     def load_draft():
         """Draft model for in-server speculation (--draft-config with
@@ -406,6 +443,7 @@ def main(argv=None) -> None:
                 slo=args.slo_config,
                 tracing=args.trace_sample_rate or None,
                 faults=args.fault_plan,
+                anomaly=args.anomaly_config,
                 overlap=False if args.no_overlap else None,
                 iteration_profile=False if args.no_iteration_profile else None)
         if args.prefix:
@@ -442,6 +480,7 @@ def main(argv=None) -> None:
             tracing=args.trace_sample_rate or None,
             faults=args.fault_plan,
             brownout=args.brownout,
+            anomaly=args.anomaly_config,
             iteration_profile=False if args.no_iteration_profile else None,
             tokenizer=tok)  # regex-constrained requests compile vs it
 

@@ -58,7 +58,11 @@ Endpoints:
                     to the flight recorder. 404 for unknown,
                     unsampled, or evicted ids.
   GET  /traces      Chrome-trace/Perfetto export of the sampled trace
-                    ring (?n=K bounds to the newest K trees).
+                    ring (?n=K bounds to the newest K trees), plus the
+                    tail-retained ring (anomalous head-unsampled
+                    requests) and — with a watchdog configured — an
+                    `anomalies` marker track carrying each rule
+                    window.
   GET  /metrics     Full Prometheus text exposition from the backend's
                     metrics registry: request-lifecycle histograms
                     (TTFT / inter-token / queue-wait / e2e, with
@@ -103,10 +107,25 @@ Endpoints:
                     ReplicatedRouter counts sum across replicas and
                     the ratios recompute post-merge. 404 when the
                     backend has no paged KV cache.
+  GET  /debug/bundle One-shot forensic debug bundle (JSON,
+                    schema "cloud_server.debug_bundle/v1"): metrics
+                    snapshot, flight window, iteration profile,
+                    head-sampled + tail-retained span trees,
+                    cache/SLO/fault/brownout/anomaly state in one
+                    artifact (?n=K bounds the ring exports,
+                    default 64). ?ring=K instead returns the last K
+                    AUTO-captured bundles (snapshotted on anomaly
+                    activation when `bundle_on_anomaly` is set).
+                    Behind a ReplicatedRouter the bundle is
+                    fleet-merged. 404 when the backend has no
+                    debug_bundle.
   POST /debug/trace {"steps": N, "logdir": optional} — wrap the next N
                     scheduler iterations in a jax profiler trace
                     (utils.tracing.capture_trace); returns the logdir
-                    to point TensorBoard/Perfetto at.
+                    to point TensorBoard/Perfetto at. An anomaly
+                    watchdog configured with capture_iters/capture_dir
+                    arms this same machinery automatically when a
+                    rule fires.
 
 Streaming text is emitted via incremental decode: each chunk is the
 SUFFIX the new tokens added to the decoded string, with a trailing
@@ -461,7 +480,17 @@ class HttpFrontend:
                     except ValueError:
                         self._json(400, {"error": '"n" must be an int'})
                         return
-                    self._json(200, chrome_trace(fn(n)))
+                    trees = fn(n)
+                    # tail-retained trees join the export (disjoint
+                    # from head-sampled by construction); anomaly
+                    # windows become a Perfetto marker track
+                    tfn = getattr(front.srv, "tail_trace_trees", None)
+                    if tfn is not None:
+                        trees = trees + tfn(n)
+                    afn = getattr(front.srv, "anomaly_events", None)
+                    self._json(200, chrome_trace(
+                        trees,
+                        anomalies=afn(n) if afn is not None else None))
                 elif url.path.startswith("/debug/requests/"):
                     rid = url.path[len("/debug/requests/"):]
                     fn = getattr(front.srv, "lookup_trace", None)
@@ -494,6 +523,27 @@ class HttpFrontend:
                         return
                     self._json(200, scheduler_chrome_trace(
                         fn(n) if n > 0 else []))
+                elif url.path == "/debug/bundle":
+                    fn = getattr(front.srv, "debug_bundle", None)
+                    if fn is None:
+                        self._json(404, {"error": "this serving "
+                                         "backend has no debug "
+                                         "bundles"})
+                        return
+                    try:
+                        n = _query_int(url, "n", 64)
+                        ring = _query_int(url, "ring", 0)
+                    except ValueError:
+                        self._json(400, {"error": '"n" and "ring" '
+                                         'must be ints'})
+                        return
+                    if ring:
+                        # ?ring=k: the last k AUTO-captured bundles
+                        # (anomaly snapshots) instead of a fresh one
+                        self._json(200, {"bundles":
+                                         front.srv.debug_bundles(ring)})
+                    else:
+                        self._json(200, fn(n))
                 elif url.path == "/metrics":
                     body = front._metrics_text().encode()
                     self.send_response(200)
@@ -696,6 +746,20 @@ class HttpFrontend:
             fstats = ffn()
             if fstats is not None:
                 payload["faults"] = fstats
+        # anomaly watchdog (active windows, per-rule fire counts, the
+        # bounded event ring) + tail-retention accounting, present
+        # only when configured. Behind the router the anomaly block
+        # is the fleet merge (merge_anomaly_stats).
+        afn = getattr(self.srv, "anomaly_stats", None)
+        if afn is not None:
+            astats = afn()
+            if astats is not None:
+                payload["anomaly"] = astats
+        ttfn = getattr(self.srv, "tail_trace_stats", None)
+        if ttfn is not None:
+            ttstats = ttfn()
+            if ttstats is not None:
+                payload["tail_retention"] = ttstats
         # live-migration counters (inference/migration.py): behind the
         # router this is the fleet merge with success_rate recomputed
         # from the merged totals; a single server reports its ledger

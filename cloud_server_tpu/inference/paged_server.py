@@ -969,7 +969,7 @@ class PagedInferenceServer:
                  flight_recorder_size: int | None = None,
                  qos=None, tracing=None, slo=None, spec_control=None,
                  iteration_profile=None, faults=None, brownout=None,
-                 overlap: bool | None = None):
+                 anomaly=None, overlap: bool | None = None):
         from cloud_server_tpu.models.quantization import QTensor
         target = jnp.dtype(cfg.dtype)
 
@@ -1229,10 +1229,31 @@ class PagedInferenceServer:
             resolve_recorder)
         from cloud_server_tpu.inference.slo import resolve_slo
         self.trace_recorder = resolve_recorder(
-            tracing, infer_cfg.trace_sample_rate)
+            tracing, infer_cfg.trace_sample_rate,
+            capacity=infer_cfg.trace_capacity,
+            tail_capacity=infer_cfg.trace_tail_capacity)
         self.slo = resolve_slo(slo, infer_cfg.slo_config)
         if self.slo is not None:
             self.metrics.slo = self.slo
+        # anomaly watchdog (inference/anomaly.py): online rule engine
+        # fed from host state the scheduler already owns — the
+        # per-iteration feed is caller-passed clocks and int deltas,
+        # zero extra dispatches/syncs (the dispatch-count regression
+        # test covers an armed watchdog + tail-retention clone). None
+        # unless configured; every call site short-circuits.
+        from cloud_server_tpu.inference.anomaly import resolve_anomaly
+        self._anomaly = resolve_anomaly(anomaly, infer_cfg.anomaly_config)
+        if self._anomaly is not None:
+            self._anomaly.bind_slo(self.slo)
+        # one-shot forensic debug bundles: bounded ring of auto-captured
+        # JSON artifacts (bundle_on_anomaly), plus GET /debug/bundle
+        self._bundle_on_anomaly = bool(infer_cfg.bundle_on_anomaly)
+        self._bundles: collections.deque = collections.deque(maxlen=8)
+        self._bundles_captured = 0
+        # per-iteration prefix-cache delta baseline for the watchdog's
+        # cache-collapse signal (lifetime counters diffed on the
+        # scheduler thread; plain int reads)
+        self._anomaly_cache_base = (0, 0)
         # iteration-granular spans staged by the dispatch paths and
         # stamped with the shared (t0, now, iteration) frame by
         # _record_iteration — one list append per traced participant
@@ -1565,14 +1586,43 @@ class PagedInferenceServer:
         a failover retry on another replica now owns completion, so
         waiters stay blocked until the retry finishes and mirrors its
         outcome back."""
-        self.metrics.observe_finish(req)
+        now = self.metrics.observe_finish(req)
+        if self._anomaly is not None:
+            ttft = (req.emit_times[0] - req.submit_time
+                    if req.emit_times and req.submit_time is not None
+                    else None)
+            itl = (None if len(req.emit_times) < 2 else
+                   (req.emit_times[-1] - req.emit_times[0])
+                   / (len(req.emit_times) - 1))
+            fired = self._anomaly.observe_request(
+                now=now, ttft_s=ttft, itl_s=itl,
+                finish_reason=req.finish_reason)
+            if fired:
+                self._on_anomaly(fired)
         # analysis: allow[lock-discipline] GIL-atomic dict pop: drop
         # any unconsumed handoff KV prefetch (the request ended
         # locally before the export fired) — safe from any completing
         # thread, no compound read-modify-write
         self._handoff_stash.pop(req.request_id, None)
-        if self.trace_recorder is not None and req.trace is not None:
-            self.trace_recorder.finish(req)
+        if self.trace_recorder is not None and (
+                req.trace is not None or req.tail_trace is not None):
+            slo_violated = False
+            if req.trace is None and self.slo is not None:
+                e2e = (None if req.submit_time is None
+                       else now - req.submit_time)
+                ttft = (req.emit_times[0] - req.submit_time
+                        if req.emit_times and req.submit_time is not None
+                        else None)
+                slo_violated = (
+                    (e2e is not None and self.slo.exceeds_target(
+                        req.slo_class, "e2e", e2e))
+                    or (ttft is not None and self.slo.exceeds_target(
+                        req.slo_class, "ttft", ttft)))
+            in_anomaly = (self._anomaly is not None
+                          and req.trace is None
+                          and self._anomaly.active_count(now) > 0)
+            self.trace_recorder.finish(req, slo_violated=slo_violated,
+                                       in_anomaly=in_anomaly)
         h = req._fail_handler
         if (h is not None and req.finish_reason is not None
                 and req.finish_reason.startswith("error") and h(req)):
@@ -3744,6 +3794,23 @@ class PagedInferenceServer:
                 pending_age_s=age,
                 budget_utilization=st.get("budget_utilization", 0.0),
                 host_gap_frac=st.get("host_gap_frac", 0.0))
+        if self._anomaly is not None:
+            # watchdog feed: every signal is a field this record
+            # already owns (the epilogue clock mark, int deltas) —
+            # zero extra dispatches/syncs/clock reads
+            hb = self._anomaly_cache_base
+            cur = (al.prefix_hit_pages, al.prefix_miss_pages)
+            self._anomaly_cache_base = cur
+            hit_d = cur[0] - hb[0]
+            fired = self._anomaly.observe_iteration(
+                now=now, host_gap_frac=st.get("host_gap_frac", 0.0),
+                pending=st["pending"],
+                preempt_delta=st["preemptions"],
+                cache_lookup_delta=hit_d + (cur[1] - hb[1]),
+                cache_hit_delta=hit_d,
+                overload_level=st.get("brownout_level", 0))
+            if fired:
+                self._on_anomaly(fired)
         st["ts"] = time.time()
         self.flight.record(**st)
         if spans:
@@ -3934,6 +4001,43 @@ class PagedInferenceServer:
             self.qos.mirror_metrics(reg)
         if self.slo is not None:
             self.slo.mirror_metrics(reg)
+        # anomaly watchdog + tail retention: families registered
+        # unconditionally (zeros) so the /metrics catalog is stable —
+        # the faults_injected_total pattern
+        from cloud_server_tpu.inference.anomaly import RULES
+        astats = (self._anomaly.stats(events=0)
+                  if self._anomaly is not None else None)
+        for rule in RULES:
+            reg.gauge("anomaly_active",
+                      "1 while the watchdog rule's anomaly window is "
+                      "open (inference/anomaly.py; zero without an "
+                      "anomaly config)",
+                      labels={"rule": rule}).set(
+                          0.0 if astats is None
+                          else float(rule in astats["active"]))
+            reg.counter("anomalies_total",
+                        "Watchdog rule activations (one per anomaly "
+                        "window opened, per rule)",
+                        labels={"rule": rule}).set_total(
+                            0 if astats is None
+                            else astats["fired_total"][rule])
+        rec = self.trace_recorder
+        tstats = (rec.tail_stats() if rec is not None
+                  and rec.tail_capacity > 0 else None)
+        reg.counter("trace_tail_retained_total",
+                    "Head-unsampled finished requests whose span "
+                    "trees the tail-retention predicate kept"
+                    ).set_total(0 if tstats is None else
+                                sum(tstats["retained_total"].values()))
+        reg.counter("trace_tail_evicted_total",
+                    "Tail-retained trees evicted from the bounded "
+                    "tail ring").set_total(
+                        0 if tstats is None
+                        else tstats["evicted_total"])
+        reg.counter("anomaly_bundles_total",
+                    "Forensic debug bundles auto-captured on anomaly "
+                    "activation (bundle_on_anomaly)").set_total(
+                        self._bundles_captured)
 
     def metrics_snapshot(self) -> dict:
         """Mergeable snapshot of every registered metric (the /metrics
@@ -4095,6 +4199,91 @@ class PagedInferenceServer:
         """Arm the /debug/trace capture: the next `n_steps` scheduler
         iterations run inside utils.tracing.capture_trace(logdir)."""
         self.tracer.request(n_steps, logdir)
+
+    def anomaly_stats(self) -> dict | None:
+        """The /stats `anomaly` block (active windows, per-rule
+        activation counts, the bounded event ring); None with no
+        watchdog. Scrape path only."""
+        return None if self._anomaly is None else self._anomaly.stats()
+
+    def anomaly_events(self, n: int | None = None) -> list[dict]:
+        """Watchdog event dicts for the Perfetto marker track; empty
+        with no watchdog."""
+        return ([] if self._anomaly is None
+                else self._anomaly.events(n))
+
+    def tail_trace_trees(self, n: int | None = None) -> list[dict]:
+        """Span trees of the tail-retained ring (anomalous requests
+        kept past head sampling); empty with tail retention off."""
+        rec = self.trace_recorder
+        return ([] if rec is None or rec.tail_capacity <= 0
+                else rec.tail_trees(n))
+
+    def tail_trace_stats(self) -> dict | None:
+        """The /stats tail-retention block; None with tail retention
+        off."""
+        rec = self.trace_recorder
+        return (None if rec is None or rec.tail_capacity <= 0
+                else rec.tail_stats())
+
+    def _on_anomaly(self, fired) -> None:
+        """Activation-edge reactions (rare by construction): snapshot
+        a forensic bundle into the bounded ring when
+        `bundle_on_anomaly` is set, and arm the existing /debug/trace
+        capture machinery when the watchdog config asks for one.
+        Forensics must never take the scheduler down — arming races
+        (a capture already running) and bundle failures are
+        swallowed."""
+        if self._bundle_on_anomaly:
+            try:
+                self._bundles.append(self.debug_bundle(
+                    trigger="anomaly:" + ",".join(fired)))
+                self._bundles_captured += 1
+            except Exception:  # noqa: BLE001 — see docstring
+                pass
+        wd = self._anomaly
+        if wd is not None and wd.capture_iters > 0 and wd.capture_dir:
+            try:
+                self.tracer.request(wd.capture_iters, wd.capture_dir)
+            except ValueError:
+                pass  # a capture is already armed/running
+
+    def debug_bundle(self, n: int = 64, *,
+                     trigger: str = "manual") -> dict:
+        """One-shot forensic artifact (the GET /debug/bundle payload):
+        everything an incident post-mortem would otherwise stitch
+        from six endpoints — metrics, the scheduler flight window,
+        retained + tail span trees, cache/brownout/migration state,
+        SLO report, fault/anomaly state — as one JSON-ready dict.
+        `n` bounds the ring exports (flight records and trace trees).
+        Scrape path only (auto-capture calls it once per activation
+        edge, which is rare by the watchdog's hysteresis)."""
+        return {
+            "schema": "cloud_server.debug_bundle/v1",
+            "trigger": trigger,
+            "ts": time.time(),
+            "anomaly": self.anomaly_stats(),
+            "metrics": self.metrics_snapshot(),
+            "profile": self.iteration_profile_stats(),
+            "flight": self.flight_window(n),
+            "traces": self.trace_trees(n),
+            "tail_traces": self.tail_trace_trees(n),
+            "tail_retention": self.tail_trace_stats(),
+            "slo": self.slo_report(),
+            "cache": self.cache_stats(),
+            "brownout": self.brownout_stats(),
+            "migration": self.migration_stats(),
+            "faults": self.fault_stats(),
+            "overlap": self.overlap_stats(),
+        }
+
+    def debug_bundles(self, n: int | None = None) -> list[dict]:
+        """The bounded ring of auto-captured bundles (oldest first;
+        `n` bounds from the newest end, n <= 0 means none)."""
+        if n is not None and n <= 0:
+            return []
+        bundles = list(self._bundles)
+        return bundles if n is None else bundles[-n:]
 
     def run_until_idle(self) -> None:
         # analysis: allow[lock-discipline] idle-polling bool() of a
@@ -4284,7 +4473,14 @@ class PagedInferenceServer:
         # the final finish event; the destination's continuation tree
         # carries the rest of the request under the same trace id)
         req.record_event("finish:migrated", time.perf_counter())
-        if self.trace_recorder is not None and req.trace is not None:
+        if self.trace_recorder is not None and (
+                req.trace is not None or req.tail_trace is not None):
+            if req.trace is None:
+                # deterministic tail retention: the SOURCE half of a
+                # migrated tree always retains (mirrors the
+                # destination's migrate_of/handoff_of tag), so a
+                # router-merged tree is never half-missing
+                req.tail_trace.annotate(migrated_out=True)
             self.trace_recorder.finish(req)
 
     def migrate_import(self, snap, *, stream=None, fail_handler=None,

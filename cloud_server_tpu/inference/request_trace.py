@@ -210,15 +210,17 @@ class _FinishedTrace:
     token / logprob lists would otherwise keep up to capacity x
     max_context of dead state alive purely for trace export. The
     trace object is shared by reference, so iteration spans stamped
-    at the end of the finishing step still land in the tree."""
+    at the end of the finishing step still land in the tree.
+    `trace` overrides which trace the snapshot exports (the tail ring
+    passes the provisional `req.tail_trace`)."""
 
     __slots__ = ("request_id", "trace", "submit_time", "tenant",
                  "finish_reason", "num_tokens", "_events",
                  "emit_times")
 
-    def __init__(self, req):
+    def __init__(self, req, trace=None):
         self.request_id = req.request_id
-        self.trace = req.trace
+        self.trace = trace if trace is not None else req.trace
         self.submit_time = req.submit_time
         self.tenant = req.tenant
         self.finish_reason = req.finish_reason
@@ -228,6 +230,31 @@ class _FinishedTrace:
 
     def timeline(self):
         return list(self._events)
+
+
+def any_trace(req):
+    """The request's head-sampled trace, else its provisional tail
+    trace, else None — annotation sites (router failover/handoff
+    tagging) must tag whichever tree may eventually be retained."""
+    tr = getattr(req, "trace", None)
+    return tr if tr is not None else getattr(req, "tail_trace", None)
+
+
+def continuation_ctx(req) -> tuple[str, str, bool] | None:
+    """The (trace_id, parent_span_id, sampled) context a failover /
+    handoff continuation submits with so it rejoins the original's
+    trace: the head-sampled trace when present (sampled=True, the
+    existing contract), else the provisional tail trace with
+    sampled=False — the continuation stays head-unsampled but keeps
+    the SHARED trace id, so when both halves tail-retain they merge
+    into one spanning tree (`merge_handoff_trees` keys on it)."""
+    tr = getattr(req, "trace", None)
+    if tr is not None:
+        return (tr.trace_id, tr.root_span_id, True)
+    tr = getattr(req, "tail_trace", None)
+    if tr is not None:
+        return (tr.trace_id, tr.root_span_id, False)
+    return None
 
 
 def build_tree(req) -> dict | None:
@@ -275,26 +302,57 @@ def build_tree(req) -> dict | None:
     }
 
 
+# Tail-retention reasons, in decision-priority order: the first
+# matching clause names the retention (`tail_retained_total{reason=}`
+# label values and the docs predicate table key off this tuple).
+TAIL_REASONS = ("failed", "deadline", "cancelled", "migrated", "slo",
+                "preempt", "anomaly")
+
+
 class TraceRecorder:
     """Head-sampled per-request trace store: a dict of in-flight
     sampled requests plus a bounded ring of finished ones (oldest
     evicted). Both servers consult it at submit (`begin`) and at
     request completion (`finish`); everything else — lookup, the ring
-    export — runs on the read path."""
+    export — runs on the read path.
 
-    def __init__(self, sample_rate: float = 1.0, capacity: int = 256):
+    Tail-based retention (`tail_capacity` > 0): every head-UNSAMPLED
+    request still gets a provisional lightweight trace (identity +
+    tags only — the schedulers skip iteration-span recording for it,
+    so the provisional cost is one small object at submit). At finish
+    the provisional tree is RETAINED into a separate bounded tail
+    ring iff the request proved anomalous: it failed / deadline-
+    expired / was cancelled, was migrated / retried / handed off,
+    missed its class SLO target, was preempted >= `tail_preempt_min`
+    times, or finished inside an open anomaly window. The decision
+    reads only request-terminal state and static config, so every
+    replica holding a segment of the same merged tree reaches the
+    same verdict (router-merged handoff trees stay whole)."""
+
+    def __init__(self, sample_rate: float = 1.0, capacity: int = 256,
+                 tail_capacity: int = 0, tail_preempt_min: int = 2):
         if not 0.0 <= sample_rate <= 1.0:
             raise ValueError("trace sample_rate must be in [0, 1]")
         if capacity <= 0:
             raise ValueError("trace ring capacity must be positive")
+        if tail_capacity < 0:
+            raise ValueError("trace tail_capacity must be >= 0")
+        if tail_preempt_min <= 0:
+            raise ValueError("trace tail_preempt_min must be positive")
         self.sample_rate = float(sample_rate)
         self.capacity = int(capacity)
+        self.tail_capacity = int(tail_capacity)
+        self.tail_preempt_min = int(tail_preempt_min)
         self._lock = threading.Lock()
         self._live: dict[str, object] = {}          # request_id -> Request
         self._ring: collections.deque = collections.deque()
         self._index: dict[str, object] = {}         # ring members by id
+        self._tail_ring: collections.deque = collections.deque()
+        self._tail_index: dict[str, object] = {}
         self.sampled_total = 0
         self.evicted_total = 0
+        self.tail_retained: dict[str, int] = {r: 0 for r in TAIL_REASONS}
+        self.tail_evicted_total = 0
 
     def should_sample(self, trace_id: str) -> bool:
         """Deterministic head decision from the trace id: every holder
@@ -320,6 +378,12 @@ class TraceRecorder:
         if sampled is None:
             sampled = self.should_sample(trace_id)
         if not sampled:
+            if self.tail_capacity > 0:
+                # provisional lightweight tree: identity only; the
+                # schedulers see req.trace is None and record no
+                # iteration spans, so the hot path pays one object
+                req.tail_trace = RequestTrace(req.request_id, trace_id,
+                                              parent_id)
             return None
         tr = RequestTrace(req.request_id, trace_id, parent_id)
         req.trace = tr
@@ -328,27 +392,84 @@ class TraceRecorder:
             self.sampled_total += 1
         return tr
 
-    def finish(self, req) -> None:
+    def _tail_reason(self, req, tr, slo_violated: bool,
+                     in_anomaly: bool) -> str | None:
+        """First matching TAIL_REASONS clause, else None (drop). All
+        inputs are request-terminal state / static config — the same
+        verdict on every replica holding this tree's segments."""
+        fr = getattr(req, "finish_reason", None) or ""
+        if fr.startswith("error"):
+            return "failed"
+        if fr in ("deadline", "cancelled", "migrated"):
+            return fr
+        tags = tr.tags
+        if ("handoff_of" in tags or "migrate_of" in tags
+                or "retry_of" in tags or "migrated_out" in tags):
+            return "migrated"
+        if slo_violated:
+            return "slo"
+        n_pre = 0
+        for name, _ts in req.timeline():
+            if name == "preempt_requeue":
+                n_pre += 1
+        if n_pre >= self.tail_preempt_min:
+            return "preempt"
+        if in_anomaly:
+            return "anomaly"
+        return None
+
+    def finish(self, req, *, slo_violated: bool = False,
+               in_anomaly: bool = False) -> None:
         """Move a completed sampled request from the live set into the
         ring (evicting the oldest past capacity). The ring keeps a
         slim _FinishedTrace snapshot, not the Request — the prompt /
-        token / logprob lists are released with the request."""
-        done = _FinishedTrace(req)
+        token / logprob lists are released with the request.
+
+        A head-UNSAMPLED request with a provisional tail trace is
+        instead judged by the tail-retention predicate: retained into
+        the tail ring (exactly once — a racing duplicate finish is
+        dropped) or forgotten. `slo_violated` / `in_anomaly` are the
+        caller-supplied clauses the recorder cannot derive itself."""
+        if getattr(req, "trace", None) is not None:
+            done = _FinishedTrace(req)
+            with self._lock:
+                self._live.pop(req.request_id, None)
+                self._ring.append(done)
+                self._index[req.request_id] = done
+                while len(self._ring) > self.capacity:
+                    old = self._ring.popleft()
+                    self._index.pop(old.request_id, None)
+                    self.evicted_total += 1
+            return
+        if self.tail_capacity <= 0:
+            return
+        tr = getattr(req, "tail_trace", None)
+        if tr is None:
+            return
+        reason = self._tail_reason(req, tr, slo_violated, in_anomaly)
+        if reason is None:
+            return
+        tr.annotate(tail_retained=reason)
+        done = _FinishedTrace(req, trace=tr)
         with self._lock:
-            self._live.pop(req.request_id, None)
-            self._ring.append(done)
-            self._index[req.request_id] = done
-            while len(self._ring) > self.capacity:
-                old = self._ring.popleft()
-                self._index.pop(old.request_id, None)
-                self.evicted_total += 1
+            if req.request_id in self._tail_index:
+                return  # concurrent duplicate finish: retain once
+            self._tail_ring.append(done)
+            self._tail_index[req.request_id] = done
+            self.tail_retained[reason] = (
+                self.tail_retained.get(reason, 0) + 1)
+            while len(self._tail_ring) > self.tail_capacity:
+                old = self._tail_ring.popleft()
+                self._tail_index.pop(old.request_id, None)
+                self.tail_evicted_total += 1
 
     def lookup(self, request_id: str) -> dict | None:
-        """Span tree for one request id (live or retained), else
-        None."""
+        """Span tree for one request id (live, head-retained, or
+        tail-retained), else None."""
         with self._lock:
             req = (self._live.get(request_id)
-                   or self._index.get(request_id))
+                   or self._index.get(request_id)
+                   or self._tail_index.get(request_id))
         return None if req is None else build_tree(req)
 
     def trees(self, n: int | None = None) -> list[dict]:
@@ -365,13 +486,39 @@ class TraceRecorder:
         trees.sort(key=lambda t: t["root"]["start"])
         return trees if n is None else trees[-n:]
 
+    def tail_trees(self, n: int | None = None) -> list[dict]:
+        """Span trees of the tail-retained ring (oldest first; `n`
+        bounds from the newest end, n <= 0 means none — the `trees`
+        contract)."""
+        if n is not None and n <= 0:
+            return []
+        with self._lock:
+            reqs = list(self._tail_ring)
+        trees = [t for t in (build_tree(r) for r in reqs)
+                 if t is not None]
+        trees.sort(key=lambda t: t["root"]["start"])
+        return trees if n is None else trees[-n:]
 
-def chrome_trace(trees: list[dict]) -> dict:
+    def tail_stats(self) -> dict:
+        """The /stats tail-retention block (scrape path)."""
+        with self._lock:
+            return {"capacity": self.tail_capacity,
+                    "retained": len(self._tail_ring),
+                    "retained_total": dict(self.tail_retained),
+                    "evicted_total": self.tail_evicted_total}
+
+
+def chrome_trace(trees: list[dict],
+                 anomalies: list[dict] | None = None) -> dict:
     """Render span trees as Chrome trace event format JSON
     (chrome://tracing / Perfetto `ui.perfetto.dev`): one complete
     ("X") event per span, processes = replicas, threads = requests.
     Timestamps are microseconds on the servers' perf_counter
-    timebase — relative durations and alignment are what matter."""
+    timebase — relative durations and alignment are what matter.
+    `anomalies` (watchdog event dicts: rule/start/end/details,
+    optionally replica) render as marker events on a dedicated
+    per-replica "anomalies" track, so an open incident window lines
+    up against the request spans it covers."""
     events: list[dict] = []
     for tree in trees:
         root = tree["root"]
@@ -396,25 +543,50 @@ def chrome_trace(trees: list[dict]) -> dict:
                 emit(child)
 
         emit(root, name=f"request {tree['request_id']}")
+
+    marker_pids: set[int] = set()
+    for ev in anomalies or ():
+        pid = int(ev.get("replica", 0))
+        if pid not in marker_pids:
+            marker_pids.add(pid)
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": "anomalies"}})
+        start = ev["start"]
+        end = ev.get("end")
+        args = dict(ev.get("details", {}))
+        if end is None:
+            end = start
+            args["open"] = True
+        events.append({
+            "ph": "X", "name": f"anomaly:{ev['rule']}",
+            "ts": start * 1e6, "dur": max(end - start, 0.0) * 1e6,
+            "pid": pid, "tid": 0, "args": args})
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
-def resolve_recorder(tracing, sample_rate: float = 0.0
+def resolve_recorder(tracing, sample_rate: float = 0.0,
+                     capacity: int = 256, tail_capacity: int = 0
                      ) -> TraceRecorder | None:
     """The one constructor both servers use: `tracing` may be a ready
     TraceRecorder, a sampling rate (float in [0, 1]), None (falling
     back to `InferConfig.trace_sample_rate`), or False — tracing
-    force-disabled regardless of the config fallback. Returns None
-    (tracing fully disabled, byte-identical pre-trace scheduling)
-    when the effective rate is 0."""
+    force-disabled regardless of the config fallback. `capacity` /
+    `tail_capacity` size the finished and tail-retained rings
+    (`InferConfig.trace_capacity` / `trace_tail_capacity`). Returns
+    None (tracing fully disabled, byte-identical pre-trace
+    scheduling) when the effective rate is 0 and tail retention is
+    off; a zero rate WITH a tail ring still records — that is the
+    "1% head sampling, broken requests always inspectable" mode."""
     if tracing is False:
         return None
     if isinstance(tracing, TraceRecorder):
         return tracing
     rate = float(tracing if tracing is not None else (sample_rate or 0.0))
-    if rate <= 0.0:
+    if rate <= 0.0 and tail_capacity <= 0:
         return None
-    return TraceRecorder(sample_rate=rate)
+    return TraceRecorder(sample_rate=rate, capacity=capacity,
+                         tail_capacity=tail_capacity)
 
 
 def merge_handoff_trees(trees: list[dict]) -> list[dict]:

@@ -46,6 +46,8 @@ from typing import Sequence
 
 import jax
 
+from cloud_server_tpu.inference.request_trace import (any_trace,
+                                                      continuation_ctx)
 from cloud_server_tpu.inference.server import QueueFullError
 
 _log = logging.getLogger(__name__)
@@ -621,11 +623,13 @@ class ReplicatedRouter:
                 orig._done.set()  # expired while handing off
                 return
             kw["deadline_s"] = remaining
-        tr0 = getattr(orig, "trace", None)
-        if tr0 is not None:
+        ctx = continuation_ctx(orig)
+        if ctx is not None:
             # the retry joins the ORIGINAL trace (same trace id,
             # parented at the original root), so the hop is one story
-            kw["trace_ctx"] = (tr0.trace_id, tr0.root_span_id, True)
+            # — tail-provisional traces too, with sampled=False so
+            # the continuation stays on the tail-retention path
+            kw["trace_ctx"] = ctx
         while True:
             with self._lock:
                 i = self._pick(tenant=kw.get("tenant"),
@@ -684,7 +688,7 @@ class ReplicatedRouter:
                     orig._on_cancel = lambda _r, _n=new: _n.cancel()
             if orig._cancel.is_set():
                 new.cancel()
-            tr = getattr(new, "trace", None)
+            tr = any_trace(new)
             if tr is not None:
                 tr.annotate(replica=i, retry_of=orig.request_id)
                 tr.add_span("router_retry", t_fail,
@@ -715,9 +719,7 @@ class ReplicatedRouter:
                 orig._done.set()  # expired while handing off
                 return
             deadline_s = remaining
-        tr0 = getattr(orig, "trace", None)
-        trace_ctx = (None if tr0 is None
-                     else (tr0.trace_id, tr0.root_span_id, True))
+        trace_ctx = continuation_ctx(orig)
         while True:
             with self._lock:
                 i = self._pick(tenant=kw.get("tenant"),
@@ -773,7 +775,7 @@ class ReplicatedRouter:
                     orig._on_cancel = lambda _r, _n=new: _n.cancel()
             if orig._cancel.is_set():
                 new.cancel()
-            tr = getattr(new, "trace", None)
+            tr = any_trace(new)
             if tr is not None:
                 tr.annotate(replica=i, migrate_of=orig.request_id)
                 tr.add_span("migrate", t_fail, time.perf_counter(),
@@ -859,9 +861,7 @@ class ReplicatedRouter:
                 orig._done.set()
                 return
             deadline_s = remaining
-        tr0 = getattr(orig, "trace", None)
-        trace_ctx = (None if tr0 is None
-                     else (tr0.trace_id, tr0.root_span_id, True))
+        trace_ctx = continuation_ctx(orig)
         last_resort = False
         while True:
             with self._lock:
@@ -922,7 +922,7 @@ class ReplicatedRouter:
                     orig._on_cancel = lambda _r, _n=new: _n.cancel()
             if orig._cancel.is_set():
                 new.cancel()
-            tr = getattr(new, "trace", None)
+            tr = any_trace(new)
             if tr is not None:
                 tr.annotate(replica=i, handoff_of=orig.request_id)
                 tr.add_span("handoff", t0, time.perf_counter(),
@@ -1297,6 +1297,117 @@ class ReplicatedRouter:
         out.sort(key=lambda rec: rec.get("ts", 0.0))
         return out
 
+    def anomaly_stats(self) -> dict | None:
+        """FLEET-wide watchdog view (anomaly.merge_anomaly_stats):
+        per-rule fire counts sum, active windows union, event rings
+        interleaved by start time with each event tagged by its TRUE
+        replica index (pre-tagged here — the merge helper's own
+        enumeration only covers replicas that HAVE a watchdog). None
+        when no replica has one."""
+        from cloud_server_tpu.inference.anomaly import (
+            merge_anomaly_stats)
+        stats = []
+        for i, r in enumerate(self.replicas):
+            fn = getattr(r, "anomaly_stats", None)
+            s = fn() if fn is not None else None
+            if s is not None:
+                s = dict(s)
+                s["events"] = [dict(ev, replica=ev.get("replica", i))
+                               for ev in s.get("events", ())]
+                stats.append(s)
+        return merge_anomaly_stats(stats)
+
+    def anomaly_events(self, n: int | None = None) -> list[dict]:
+        """Fleet anomaly events for the /traces marker track, each
+        tagged with its replica, ordered by window start."""
+        out = []
+        for i, r in enumerate(self.replicas):
+            fn = getattr(r, "anomaly_events", None)
+            if fn is not None:
+                out += [dict(ev, replica=ev.get("replica", i))
+                        for ev in fn(n)]
+        out.sort(key=lambda e: e["start"])
+        return out if n is None or n <= 0 else out[-n:]
+
+    def tail_trace_trees(self, n: int | None = None) -> list[dict]:
+        """FLEET-wide tail-retained span trees, replica-tagged and
+        handoff-merged exactly like trace_trees — the retention
+        predicate is replica-deterministic (both halves of a handoff
+        always retain), so a disaggregated anomalous request reads as
+        ONE gap-free tree here."""
+        if n is not None and n <= 0:
+            return []
+        out = []
+        for i, r in enumerate(self.replicas):
+            fn = getattr(r, "tail_trace_trees", None)
+            if fn is None:
+                continue
+            for tree in fn(n):
+                tree["root"]["tags"].setdefault("replica", i)
+                out.append(tree)
+        if self._disagg:
+            from cloud_server_tpu.inference.request_trace import (
+                merge_handoff_trees)
+            out = merge_handoff_trees(out)
+        out.sort(key=lambda t: t["root"]["start"])
+        return out if n is None else out[-n:]
+
+    def tail_trace_stats(self) -> dict | None:
+        """Fleet tail-retention accounting: capacities and counts sum
+        across replicas (per-reason retained_total merges per key).
+        None when no replica retains tail traces."""
+        merged: dict | None = None
+        for r in self.replicas:
+            fn = getattr(r, "tail_trace_stats", None)
+            s = fn() if fn is not None else None
+            if s is None:
+                continue
+            if merged is None:
+                merged = {"capacity": 0, "retained": 0,
+                          "retained_total": {}, "evicted_total": 0}
+            merged["capacity"] += s["capacity"]
+            merged["retained"] += s["retained"]
+            merged["evicted_total"] += s["evicted_total"]
+            for k, v in s["retained_total"].items():
+                merged["retained_total"][k] = (
+                    merged["retained_total"].get(k, 0) + v)
+        return merged
+
+    def debug_bundle(self, n: int = 64, *,
+                     trigger: str = "manual") -> dict:
+        """FLEET-wide forensic bundle (the GET /debug/bundle payload
+        behind the router): the same schema as a single replica's,
+        assembled from the router's own merged views — counts summed,
+        trees replica-tagged and handoff-merged, plus the
+        router-only breaker/role blocks."""
+        return {
+            "schema": "cloud_server.debug_bundle/v1",
+            "trigger": trigger,
+            "ts": time.time(),
+            "anomaly": self.anomaly_stats(),
+            "metrics": self.metrics_snapshot(),
+            "flight": self.flight_window(n),
+            "traces": self.trace_trees(n),
+            "tail_traces": self.tail_trace_trees(n),
+            "tail_retention": self.tail_trace_stats(),
+            "slo": self.slo_report(),
+            "cache": self.cache_stats(),
+            "migration": self.migration_stats(),
+            "breakers": self.breaker_states(),
+            "roles": self.replica_roles(),
+        }
+
+    def debug_bundles(self, n: int | None = None) -> list[dict]:
+        """Auto-captured bundles across the fleet, each tagged with
+        the replica whose watchdog snapshotted it, oldest first."""
+        out = []
+        for i, r in enumerate(self.replicas):
+            fn = getattr(r, "debug_bundles", None)
+            if fn is not None:
+                out += [dict(b, replica=i) for b in fn(n)]
+        out.sort(key=lambda b: b.get("ts", 0.0))
+        return out if n is None or n <= 0 else out[-n:]
+
     def step(self) -> int:
         busy = 0
         for i, r in enumerate(self.replicas):
@@ -1370,7 +1481,8 @@ class ReplicatedRouter:
                         if self._accepts_hook[i] else None)
                 try:
                     new = imp(snap, stream=kw["stream"],
-                              fail_handler=hook)
+                              fail_handler=hook,
+                              trace_ctx=continuation_ctx(req))
                 except Exception as exc:  # noqa: BLE001 — next replica
                     with self._lock:
                         self._inflight[i] -= 1
@@ -1401,7 +1513,7 @@ class ReplicatedRouter:
                         req._on_cancel = lambda _r, _n=new: _n.cancel()
                 if req._cancel.is_set():
                     new.cancel()
-                tr = getattr(new, "trace", None)
+                tr = any_trace(new)
                 if tr is not None:
                     tr.annotate(replica=i, migrate_of=req.request_id)
                     tr.add_span("migrate", t0, time.perf_counter(),

@@ -459,6 +459,11 @@ class Request:
     # RequestTrace when head sampling selected it at submit, else None
     # (unsampled, or tracing disabled — zero cost either way)
     trace: object | None = None
+    # tail-based retention: the provisional lightweight trace a
+    # head-UNSAMPLED request carries when the recorder runs a tail
+    # ring; judged (retain or forget) at finish. None when head-
+    # sampled or tail retention is off.
+    tail_trace: object | None = None
     # SLO class (inference/slo.py): the tenant's QoS priority class
     # name, resolved once at submit when SLO tracking is configured;
     # None otherwise (the tracker maps None onto its "default" entry)
@@ -652,7 +657,7 @@ class InferenceServer:
                  prefix_remainder_cap: int = 1024,
                  metrics: ServingMetrics | None = None,
                  qos=None, tracing=None, slo=None,
-                 iteration_profile=None, faults=None,
+                 iteration_profile=None, faults=None, anomaly=None,
                  overlap: bool | None = None):
         # Serving never needs f32 master weights: pre-cast float32 leaves to
         # the compute dtype once, instead of streaming 2x the bytes and
@@ -777,10 +782,25 @@ class InferenceServer:
             resolve_recorder)
         from cloud_server_tpu.inference.slo import resolve_slo
         self.trace_recorder = resolve_recorder(
-            tracing, infer_cfg.trace_sample_rate)
+            tracing, infer_cfg.trace_sample_rate,
+            capacity=infer_cfg.trace_capacity,
+            tail_capacity=infer_cfg.trace_tail_capacity)
         self.slo = resolve_slo(slo, infer_cfg.slo_config)
         if self.slo is not None:
             self.metrics.slo = self.slo
+        # anomaly watchdog (inference/anomaly.py): None unless
+        # configured — every guarded call site short-circuits and the
+        # scheduler is byte-identical to the pre-watchdog build. The
+        # contiguous server feeds the per-finish rules plus a thin
+        # per-step signal (no flight recorder here); bundle
+        # auto-capture shares the paged server's contract.
+        from cloud_server_tpu.inference.anomaly import resolve_anomaly
+        self._anomaly = resolve_anomaly(anomaly, infer_cfg.anomaly_config)
+        if self._anomaly is not None:
+            self._anomaly.bind_slo(self.slo)
+        self._bundle_on_anomaly = bool(infer_cfg.bundle_on_anomaly)
+        self._bundles: collections.deque = collections.deque(maxlen=8)
+        self._bundles_captured = 0
         # deterministic fault injection (inference/faults.py): None
         # unless configured — every guarded call site short-circuits,
         # so the scheduler is byte-identical to the pre-fault build
@@ -958,9 +978,38 @@ class InferenceServer:
         a failover retry on another replica now owns completion, so
         waiters stay blocked until the retry finishes and mirrors its
         outcome back."""
-        self.metrics.observe_finish(req)
-        if self.trace_recorder is not None and req.trace is not None:
-            self.trace_recorder.finish(req)
+        now = self.metrics.observe_finish(req)
+        if self._anomaly is not None:
+            ttft = (req.emit_times[0] - req.submit_time
+                    if req.emit_times and req.submit_time is not None
+                    else None)
+            itl = (None if len(req.emit_times) < 2 else
+                   (req.emit_times[-1] - req.emit_times[0])
+                   / (len(req.emit_times) - 1))
+            fired = self._anomaly.observe_request(
+                now=now, ttft_s=ttft, itl_s=itl,
+                finish_reason=req.finish_reason)
+            if fired:
+                self._on_anomaly(fired)
+        if self.trace_recorder is not None and (
+                req.trace is not None or req.tail_trace is not None):
+            slo_violated = False
+            if req.trace is None and self.slo is not None:
+                e2e = (None if req.submit_time is None
+                       else now - req.submit_time)
+                ttft = (req.emit_times[0] - req.submit_time
+                        if req.emit_times and req.submit_time is not None
+                        else None)
+                slo_violated = (
+                    (e2e is not None and self.slo.exceeds_target(
+                        req.slo_class, "e2e", e2e))
+                    or (ttft is not None and self.slo.exceeds_target(
+                        req.slo_class, "ttft", ttft)))
+            in_anomaly = (self._anomaly is not None
+                          and req.trace is None
+                          and self._anomaly.active_count(now) > 0)
+            self.trace_recorder.finish(req, slo_violated=slo_violated,
+                                       in_anomaly=in_anomaly)
         h = req._fail_handler
         if (h is not None and req.finish_reason is not None
                 and req.finish_reason.startswith("error") and h(req)):
@@ -1300,6 +1349,16 @@ class InferenceServer:
                             for p, v in phases.items():
                                 hists[p].observe(v)
                     self.last_busy_ts = time.time()
+                    if self._anomaly is not None:
+                        # thin per-step feed (no flight recorder here):
+                        # one clock read, matching the brownout
+                        # detector's per-observe budget
+                        with self._lock:
+                            pending = len(self._pending)
+                        fired = self._anomaly.observe_iteration(
+                            now=time.perf_counter(), pending=pending)
+                        if fired:
+                            self._on_anomaly(fired)
                 else:
                     self.idle_iterations += 1
                 return n_active
@@ -1531,6 +1590,43 @@ class InferenceServer:
             self.qos.mirror_metrics(reg)
         if self.slo is not None:
             self.slo.mirror_metrics(reg)
+        # anomaly watchdog + tail retention: families registered
+        # unconditionally (zeros) so the /metrics catalog is stable —
+        # the faults_injected_total pattern
+        from cloud_server_tpu.inference.anomaly import RULES
+        astats = (self._anomaly.stats(events=0)
+                  if self._anomaly is not None else None)
+        for rule in RULES:
+            reg.gauge("anomaly_active",
+                      "1 while the watchdog rule's anomaly window is "
+                      "open (inference/anomaly.py; zero without an "
+                      "anomaly config)",
+                      labels={"rule": rule}).set(
+                          0.0 if astats is None
+                          else float(rule in astats["active"]))
+            reg.counter("anomalies_total",
+                        "Watchdog rule activations (one per anomaly "
+                        "window opened, per rule)",
+                        labels={"rule": rule}).set_total(
+                            0 if astats is None
+                            else astats["fired_total"][rule])
+        rec = self.trace_recorder
+        tstats = (rec.tail_stats() if rec is not None
+                  and rec.tail_capacity > 0 else None)
+        reg.counter("trace_tail_retained_total",
+                    "Head-unsampled finished requests whose span "
+                    "trees the tail-retention predicate kept"
+                    ).set_total(0 if tstats is None else
+                                sum(tstats["retained_total"].values()))
+        reg.counter("trace_tail_evicted_total",
+                    "Tail-retained trees evicted from the bounded "
+                    "tail ring").set_total(
+                        0 if tstats is None
+                        else tstats["evicted_total"])
+        reg.counter("anomaly_bundles_total",
+                    "Forensic debug bundles auto-captured on anomaly "
+                    "activation (bundle_on_anomaly)").set_total(
+                        self._bundles_captured)
 
     def metrics_snapshot(self) -> dict:
         """Mergeable snapshot of every registered metric (the /metrics
@@ -1590,6 +1686,86 @@ class InferenceServer:
         """Arm the /debug/trace capture: the next `n_steps` scheduler
         iterations run inside utils.tracing.capture_trace(logdir)."""
         self.tracer.request(n_steps, logdir)
+
+    def anomaly_stats(self) -> dict | None:
+        """The /stats `anomaly` block (active windows, per-rule
+        activation counts, the bounded event ring); None with no
+        watchdog. Scrape path only."""
+        return None if self._anomaly is None else self._anomaly.stats()
+
+    def anomaly_events(self, n: int | None = None) -> list[dict]:
+        """Watchdog event dicts for the Perfetto marker track; empty
+        with no watchdog."""
+        return ([] if self._anomaly is None
+                else self._anomaly.events(n))
+
+    def tail_trace_trees(self, n: int | None = None) -> list[dict]:
+        """Span trees of the tail-retained ring (anomalous requests
+        kept past head sampling); empty with tail retention off."""
+        rec = self.trace_recorder
+        return ([] if rec is None or rec.tail_capacity <= 0
+                else rec.tail_trees(n))
+
+    def tail_trace_stats(self) -> dict | None:
+        """The /stats tail-retention block; None with tail retention
+        off."""
+        rec = self.trace_recorder
+        return (None if rec is None or rec.tail_capacity <= 0
+                else rec.tail_stats())
+
+    def _on_anomaly(self, fired) -> None:
+        """Activation-edge reactions (rare by construction): snapshot
+        a forensic bundle into the bounded ring when
+        `bundle_on_anomaly` is set, and arm the existing /debug/trace
+        capture machinery when the watchdog config asks for one.
+        Forensics must never take the scheduler down — arming races
+        (a capture already running) and bundle failures are
+        swallowed."""
+        if self._bundle_on_anomaly:
+            try:
+                self._bundles.append(self.debug_bundle(
+                    trigger="anomaly:" + ",".join(fired)))
+                self._bundles_captured += 1
+            except Exception:  # noqa: BLE001 — see docstring
+                pass
+        wd = self._anomaly
+        if wd is not None and wd.capture_iters > 0 and wd.capture_dir:
+            try:
+                self.tracer.request(wd.capture_iters, wd.capture_dir)
+            except ValueError:
+                pass  # a capture is already armed/running
+
+    def debug_bundle(self, n: int = 64, *,
+                     trigger: str = "manual") -> dict:
+        """One-shot forensic artifact (the GET /debug/bundle payload):
+        everything an incident post-mortem would otherwise stitch
+        from five endpoints — metrics, retained + tail span trees,
+        SLO report, fault/anomaly state — as one JSON-ready dict.
+        `n` bounds the ring exports. Scrape path only (auto-capture
+        calls it once per activation edge, which is rare by the
+        watchdog's hysteresis)."""
+        return {
+            "schema": "cloud_server.debug_bundle/v1",
+            "trigger": trigger,
+            "ts": time.time(),
+            "anomaly": self.anomaly_stats(),
+            "metrics": self.metrics_snapshot(),
+            "profile": self.iteration_profile_stats(),
+            "traces": self.trace_trees(n),
+            "tail_traces": self.tail_trace_trees(n),
+            "tail_retention": self.tail_trace_stats(),
+            "slo": self.slo_report(),
+            "faults": self.fault_stats(),
+            "overlap": self.overlap_stats(),
+        }
+
+    def debug_bundles(self, n: int | None = None) -> list[dict]:
+        """The bounded ring of auto-captured bundles (oldest first;
+        `n` bounds from the newest end, n <= 0 means none)."""
+        if n is not None and n <= 0:
+            return []
+        bundles = list(self._bundles)
+        return bundles if n is None else bundles[-n:]
 
     def run_until_idle(self) -> None:
         while self.num_pending or self.num_active:

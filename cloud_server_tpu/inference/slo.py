@@ -236,7 +236,36 @@ class SLOTracker:
             return  # metric untracked for this class
         rc.observe(value <= self.classes[name].target(metric), now)
 
+    def exceeds_target(self, cls: str | None, metric: str,
+                       value: float) -> bool:
+        """Did `value` miss the class's target for `metric`? False for
+        untracked classes/metrics — the tail-retention predicate's SLO
+        clause (per-replica deterministic: targets are static config,
+        identical fleet-wide by construction)."""
+        name = self.resolve_class(cls)
+        if name is None:
+            return False
+        target = self.classes[name].target(metric)
+        return target is not None and value > target
+
     # -- read path ----------------------------------------------------------
+
+    def burn_rates(self, now: float | None = None
+                   ) -> dict[str, dict[str, tuple[float, float]]]:
+        """{class: {metric: (shortest-window burn, longest-window
+        burn)}} — the two numbers a multi-window burn alert compares
+        (`anomaly.py`'s `slo_burn` rule samples this instead of the
+        full `report()`, which builds the whole mergeable dict)."""
+        now = self._clock() if now is None else now
+        fast_w, slow_w = self.windows[0], self.windows[-1]
+        out: dict[str, dict[str, tuple[float, float]]] = {}
+        for (name, metric), rc in self._counts.items():
+            obj = self.classes[name].objective
+            fast = _burn(*rc.window(fast_w, now), obj)
+            slow = (fast if slow_w == fast_w
+                    else _burn(*rc.window(slow_w, now), obj))
+            out.setdefault(name, {})[metric] = (fast, slow)
+        return out
 
     def report(self, now: float | None = None) -> dict:
         """Attainment + burn rate per class, metric, and window, with

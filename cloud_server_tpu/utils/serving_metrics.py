@@ -432,7 +432,10 @@ class ServingMetrics:
         req.record_event("preempt_requeue", now)
         self.requeues.inc()
 
-    def observe_finish(self, req, now: float | None = None) -> None:
+    def observe_finish(self, req, now: float | None = None) -> float:
+        """Terminal-state bookkeeping; returns the finish moment so
+        callers (tail retention, the anomaly watchdog) reuse the one
+        timestamp instead of re-reading the clock."""
         now = time.perf_counter() if now is None else now
         reason = req.finish_reason or ""
         req.record_event(f"finish:{reason}", now)
@@ -449,6 +452,7 @@ class ServingMetrics:
             if self.slo is not None:
                 self.slo.observe(req.slo_class, "e2e",
                                  now - req.submit_time, now)
+        return now
 
 
 # ---------------------------------------------------------------------------

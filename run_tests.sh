@@ -57,7 +57,11 @@
 # handoff e2e token exactness with the merged cross-replica span
 # tree, QoS continuation billing; the 4-replica drain-compose soak
 # and the batch-flood non-starvation e2e are marked slow) rides
-# [a-f]. The suite is also runnable
+# [a-f], as does tests/test_anomaly.py (anomaly watchdog + tail-based
+# trace retention + forensic bundles: rule hysteresis with injected
+# clocks, the retention predicate clause by clause, fleet stat
+# merging, bundle auto-capture, /debug/bundle). The suite is also
+# runnable
 # standalone:
 #   python -m cloud_server_tpu.analysis [--json] [--checker <id>]
 #

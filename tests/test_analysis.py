@@ -241,6 +241,60 @@ def test_checker_flags_bad_fault_paths():
                             ("BadOverloadDetector.shed_fine",))
 
 
+def test_registry_covers_anomaly():
+    """The anomaly watchdog rides all three passes: observe_* run
+    once per busy iteration / per completion (hot-path), the module
+    is stdlib-only host policy (DD3), and its leaf lock is
+    lock-discipline audited. The tail-retention verdict helpers ride
+    the existing request_trace/slo rosters."""
+    from cloud_server_tpu.analysis import locks
+    quals = set(HOT_PATHS["cloud_server_tpu/inference/anomaly.py"])
+    for needed in ("AnomalyWatchdog.observe_iteration",
+                   "AnomalyWatchdog.observe_request",
+                   "AnomalyWatchdog.active_count",
+                   "AnomalyWatchdog._update_rule",
+                   "AnomalyWatchdog._shift"):
+        assert needed in quals, f"{needed} dropped from HOT_PATHS"
+    assert ("cloud_server_tpu/inference/anomaly.py"
+            in dispatch.HOST_POLICY_MODULES), \
+        "anomaly.py dropped from the DD3 host-policy roster"
+    assert ("cloud_server_tpu/inference/anomaly.py"
+            in locks.LOCK_ROSTER), \
+        "anomaly.py dropped from the lock-discipline roster"
+    # the tail-retention verdict + SLO target check ride the existing
+    # rosters of the modules they live in
+    assert ("TraceRecorder._tail_reason"
+            in HOT_PATHS["cloud_server_tpu/inference/request_trace.py"])
+    assert ("SLOTracker.exceeds_target"
+            in HOT_PATHS["cloud_server_tpu/inference/slo.py"])
+
+
+def test_checker_flags_bad_anomaly_paths():
+    """Fixture round-trip proving the checker is LIVE on the new
+    module's violation shapes: wall-clock window stamps, numpy signal
+    buffers, logging the fired rule from the scheduler thread, disk
+    IO for the bundle on the activation edge, a blocking sync to
+    grade a latency signal, sleeping out the hysteresis hold — each
+    must fire; the dict/float window-update shape the real watchdog
+    uses must not."""
+    src = (_FIXTURES / "hot_path_anomaly_bad.py").read_text()
+    cases = {
+        "BadWatchdog.observe_wall_clock": "time.time",
+        "BadWatchdog.observe_numpy": "numpy",
+        "BadWatchdog.fire_logged": "logging",
+        "BadWatchdog.bundle_io": "I/O",
+        "BadWatchdog.shift_synced": "sync",
+        "BadWatchdog.hold_sleeps": "sleep",
+    }
+    for qual, needle in cases.items():
+        findings = check_source("hot_path_anomaly_bad.py", src, (qual,))
+        assert findings, f"{qual}: expected a finding"
+        assert any(needle in f.message for f in findings), \
+            f"{qual}: {[str(f) for f in findings]}"
+    assert not check_source("hot_path_anomaly_bad.py", src,
+                            ("BadWatchdog.update_fine",))
+
+
 def test_registry_covers_migration():
     """Live migration rides all three passes: the ledger's record
     hooks run while a scheduler's step lock is held (hot-path), the

@@ -165,7 +165,12 @@ def test_serve_http_cli_paged(tmp_path):
          "--serve-http", "0", "--page-size", "8", "--max-slots", "2",
          # in-server DRAFT-MODEL speculation through the real CLI
          "--draft-config", str(tmp_path / "draft.json"),
-         "--num-draft", "2"],
+         "--num-draft", "2",
+         # anomaly watchdog + tail retention knobs through the real
+         # CLI (armed-but-quiet: default thresholds, tiny tail ring)
+         "--anomaly-config", '{"warmup": 4}',
+         "--trace-tail-capacity", "8", "--trace-capacity", "16",
+         "--bundle-on-anomaly"],
         env=env, stderr=subprocess.PIPE, text=True)
     try:
         import queue
@@ -203,9 +208,16 @@ def test_serve_http_cli_paged(tmp_path):
                              "max_new_tokens": 4}).encode(),
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=120) as resp:
-            lines = [json.loads(ln) for ln in resp if ln.strip()]
-        assert lines[-1]["done"] is True
-        assert len(lines[-1]["tokens"]) == 4
+            out = [json.loads(ln) for ln in resp if ln.strip()]
+        assert out[-1]["done"] is True
+        assert len(out[-1]["tokens"]) == 4
+        # the CLI really armed the watchdog + tail ring: /stats grows
+        # the anomaly and tail_retention blocks (quiet — no windows)
+        with urllib.request.urlopen(f"http://{address}/stats?n=4",
+                                    timeout=120) as resp:
+            stats = json.loads(resp.read())
+        assert stats["anomaly"]["active"] == []
+        assert stats["tail_retention"]["capacity"] == 8
     finally:
         proc.send_signal(signal.SIGINT)
         try:

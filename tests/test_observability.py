@@ -298,12 +298,33 @@ _FAULTS_BROWNOUT_KW = {
     "brownout": {"alpha": 0.3},
     "qos": {"tenants": {"a": {}, "b": {}}}}
 
+# anomaly+tail clone: an armed-but-quiet watchdog (every rule enabled
+# with astronomically far thresholds, graded every iteration past a
+# zero warmup — the hardest observe path) plus tail-based trace
+# retention at 0% head sampling. The watchdog feed and the provisional
+# tail trees must add zero dispatches/syncs. (Unconfigured — no
+# watchdog, no tail ring — is the `plain` clone, unchanged.)
+
+
+def _anomaly_tail_kw():
+    from cloud_server_tpu.inference.request_trace import TraceRecorder
+    return {
+        "tracing": TraceRecorder(sample_rate=0.0, tail_capacity=64),
+        "anomaly": {"warmup": 0, "check_every": 1,
+                    "rules": {"latency_shift": {"factor": 1e9},
+                              "cache_collapse": {"min_baseline": 2.0},
+                              "breaker_flap": {"flaps": 10 ** 9},
+                              "deadline_spike": {"count": 10 ** 9},
+                              "preempt_spike": {"count": 10 ** 9},
+                              "host_gap": {"factor": 1e9},
+                              "wedged": {"stall_s": 1e9}}}}
+
 
 @pytest.mark.parametrize("extra_kw",
                          [{}, _TRACING_SLO_KW, _QOS_CACHE_KW,
-                          _FAULTS_BROWNOUT_KW],
+                          _FAULTS_BROWNOUT_KW, _anomaly_tail_kw()],
                          ids=["plain", "tracing_slo", "qos_cache",
-                              "faults_brownout"])
+                              "faults_brownout", "anomaly_tail"])
 def test_mixed_step_dispatch_and_sync_count(params, monkeypatch,
                                             extra_kw):
     """The instrumented mixed-scheduler iteration still issues exactly
@@ -376,10 +397,24 @@ def test_mixed_step_dispatch_and_sync_count(params, monkeypatch,
     assert warm.done and long.done
     assert srv.metrics_snapshot()[
         "cloud_server_requests_finished_total"]["value"] == 2
-    if "tracing" in extra_kw:  # the clone really ran with both live
+    if "slo" in extra_kw:  # the clone really ran with both live
         assert len(srv.trace_trees()) == 2
         assert srv.slo_report()["classes"]["default"]["metrics"][
             "e2e"]["lifetime"]["total"] == 2
+    if "anomaly" in extra_kw:  # armed, observed every iteration, quiet
+        astats = srv.anomaly_stats()
+        # host_gap EWMA is folded on every observed iteration, so its
+        # presence proves the watchdog feed really ran in the loop
+        assert "host_gap" in astats["signals"]
+        assert astats["active"] == []
+        assert sum(astats["fired_total"].values()) == 0
+        # tail ring live but empty: both requests finished cleanly, so
+        # their provisional trees were graded and dropped
+        tstats = srv.tail_trace_stats()
+        assert tstats["capacity"] == 64
+        assert tstats["retained"] == 0
+        assert srv.tail_trace_trees() == []
+        assert srv.trace_trees() == []  # 0% head sampling held
     if "qos" in extra_kw:  # the cache-attribution path really ran
         cs = srv.cache_stats()
         assert cs["tenants"]  # walks were recorded per tenant

@@ -268,7 +268,11 @@ def test_no_config_parity(params):
     srv.run_until_idle()
     assert req.slo_class is None
     assert srv.slo_report() is None
-    assert not any("slo_" in k for k in srv.metrics_snapshot())
+    # no cloud_server_slo_* FAMILY registered (the anomaly watchdog's
+    # always-registered families carry a rule="slo_burn" LABEL, which
+    # is not an SLO-tracker family)
+    assert not any(k.startswith("cloud_server_slo_")
+                   for k in srv.metrics_snapshot())
 
 
 # ---------------------------------------------------------------------------
