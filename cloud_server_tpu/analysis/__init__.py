@@ -8,7 +8,7 @@ on any unsuppressed finding; the same gate runs as a tier-1 test
 step. Checker ids, rules, and the suppression-pragma syntax are
 cataloged in ``docs/analysis.md`` (drift-checked both ways).
 
-The three passes shipped today:
+The four passes shipped today:
 
   * ``hot-path`` (``hot_path.py``) — the per-iteration scheduler code
     registered in ``HOT_PATHS`` must stay free of device work,
@@ -23,6 +23,12 @@ The three passes shipped today:
     ``device_get`` per scheduler iteration, jax-free host-policy
     modules, and statically bounded values into jitted static
     arguments (the compile-variant invariant).
+  * ``lifecycle-discipline`` (``lifecycle.py``) — every terminal
+    request path reaches ``_complete`` exactly once in the documented
+    telemetry -> fail-handler -> ``_done`` -> callback order, every
+    allocated KV page is released/registered/transferred on every
+    edge, and lock-held regions cannot tear guarded state across a
+    may-raise call.
 
 Deliberate exceptions are carried in the code as
 ``# analysis: allow[<checker>] <reason>`` pragmas; the reason is
@@ -41,4 +47,5 @@ from cloud_server_tpu.analysis.framework import (  # noqa: F401
 # importing the pass modules registers them
 from cloud_server_tpu.analysis.hot_path import (  # noqa: F401
     HOT_PATHS, check_hot_paths, check_source)
-from cloud_server_tpu.analysis import dispatch, locks  # noqa: F401
+from cloud_server_tpu.analysis import (  # noqa: F401
+    dispatch, lifecycle, locks)

@@ -1,10 +1,12 @@
-"""CLI: ``python -m cloud_server_tpu.analysis [--json]
+"""CLI: ``python -m cloud_server_tpu.analysis [--json | --sarif]
 [--checker <id>]... [repo_root]``.
 
 Exit status 0 = every pass is clean (suppressions honored); 1 = at
-least one unsuppressed finding; 2 = bad usage (unknown checker id).
-Text findings go to stderr (``path:line: [checker] [symbol] message``);
-``--json`` writes the stable machine shape to stdout instead.
+least one unsuppressed finding; 2 = bad usage (unknown checker id, or
+``--json`` combined with ``--sarif``). Text findings go to stderr
+(``path:line: [checker] [symbol] message``); ``--json`` writes the
+stable machine shape to stdout instead, ``--sarif`` the SARIF 2.1.0
+shape CI renders as code annotations.
 """
 
 import argparse
@@ -13,6 +15,7 @@ import sys
 
 from cloud_server_tpu.analysis import (registered_passes, render_text,
                                        report_json, run_analysis)
+from cloud_server_tpu.analysis.framework import report_sarif
 
 
 def main(argv: list[str]) -> int:
@@ -21,8 +24,12 @@ def main(argv: list[str]) -> int:
         description="Serving-stack static analysis suite.")
     parser.add_argument("root", nargs="?", default=None,
                         help="repository root (default: autodetected)")
-    parser.add_argument("--json", action="store_true",
-                        help="emit the stable JSON report on stdout")
+    fmt = parser.add_mutually_exclusive_group()
+    fmt.add_argument("--json", action="store_true",
+                     help="emit the stable JSON report on stdout")
+    fmt.add_argument("--sarif", action="store_true",
+                     help="emit a SARIF 2.1.0 report on stdout "
+                          "(for CI code annotations)")
     parser.add_argument("--checker", action="append", default=None,
                         metavar="ID",
                         help="run only this checker (repeatable); "
@@ -35,6 +42,9 @@ def main(argv: list[str]) -> int:
         return 2
     if args.json:
         json.dump(report_json(report), sys.stdout, indent=2)
+        print()
+    elif args.sarif:
+        json.dump(report_sarif(report), sys.stdout, indent=2)
         print()
     else:
         print(render_text(report), file=sys.stderr)

@@ -182,17 +182,43 @@ class Pragma:
     covers: tuple             # finding lines it suppresses
 
 
+def _statement_extents(source: str) -> list[tuple[int, int]]:
+    """``[(lineno, end_lineno)]`` for every multi-line SIMPLE
+    statement (no nested block) — the lexical extents pragma coverage
+    expands over. A multi-line call or assignment reports findings at
+    sub-expression lines, so a pragma anchored on (or inside) the
+    statement must cover every line of it. ``[]`` when the file does
+    not parse: coverage then stays line-anchored."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    out: list[tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.stmt)
+                and "body" not in node._fields
+                and "cases" not in node._fields
+                and (node.end_lineno or node.lineno) > node.lineno):
+            out.append((node.lineno, node.end_lineno))
+    return out
+
+
 def collect_pragmas(path: str, source: str
                     ) -> tuple[list, list]:
     """Scan one file for suppression pragmas.
 
-    Returns ``([Pragma, ...], reasonless_findings)``. A pragma on a
-    comment-only line also covers the statement it annotates (the
-    next non-blank, non-comment line); a pragma with no reason text
-    is a ``pragma`` finding and suppresses nothing."""
+    Returns ``([Pragma, ...], reasonless_findings)``. A pragma covers
+    the FULL lexical extent of the statement it sits on (or inside —
+    a comment line between the continuation lines of a multi-line
+    call counts), because findings anchor at sub-expression lines,
+    not at the statement's first line. A pragma on a comment-only
+    line also covers the statement it annotates (the next non-blank,
+    non-comment line, again to its full extent); a pragma with no
+    reason text is a ``pragma`` finding and suppresses nothing."""
     pragmas: list[Pragma] = []
     bad: list[Finding] = []
     lines = source.splitlines()
+    extents: list[tuple[int, int]] | None = None  # computed lazily
     for lineno, text in enumerate(lines, start=1):
         for m in _PRAGMA_RE.finditer(text):
             checker, reason = m.group(1), m.group(2).strip()
@@ -202,17 +228,30 @@ def collect_pragmas(path: str, source: str
                     f"suppression pragma allow[{checker}] without a "
                     "reason — every exception must say why"))
                 continue
-            covers = [lineno]
+            covers = {lineno}
             if text.lstrip().startswith("#"):
                 # comment-only pragma: also covers the statement it
                 # annotates — the next non-blank, non-comment line
                 for j in range(lineno, len(lines)):
                     nxt = lines[j].strip()
                     if nxt and not nxt.startswith("#"):
-                        covers.append(j + 1)
+                        covers.add(j + 1)
                         break
+            if extents is None:
+                extents = _statement_extents(source)
+            for anchor in sorted(covers):
+                # innermost simple statement containing the anchor:
+                # cover its whole lexical extent
+                span: tuple[int, int] | None = None
+                for s, e in extents:
+                    if s <= anchor <= e and (
+                            span is None
+                            or e - s < span[1] - span[0]):
+                        span = (s, e)
+                if span is not None:
+                    covers.update(range(span[0], span[1] + 1))
             pragmas.append(Pragma(path, lineno, checker, reason,
-                                  tuple(covers)))
+                                  tuple(sorted(covers))))
     return pragmas, bad
 
 
@@ -350,4 +389,45 @@ def report_json(report: Report) -> dict:
         "findings": [f.to_dict() for f in report.findings],
         "suppressed": [{**f.to_dict(), "reason": reason}
                        for f, reason in report.suppressed],
+    }
+
+
+def report_sarif(report: Report) -> dict:
+    """SARIF 2.1.0 reporter (the ``--sarif`` CLI shape) — the format
+    CI renders as inline code annotations. One run, one rule per
+    checker that RAN (so annotation UIs can group by rule even on a
+    clean report), one result per unsuppressed finding; suppressed
+    findings are omitted (they are the accepted exceptions, not
+    annotations to re-litigate on every PR)."""
+    rules = [{"id": cid} for cid in report.checkers]
+    rule_ids = {cid for cid in report.checkers}
+    for f in report.findings:
+        if f.checker not in rule_ids:      # e.g. the implicit `pragma`
+            rule_ids.add(f.checker)
+            rules.append({"id": f.checker})
+    results = []
+    for f in report.findings:
+        message = (f"[{f.symbol}] {f.message}" if f.symbol
+                   else f.message)
+        results.append({
+            "ruleId": f.checker,
+            "level": "error",
+            "message": {"text": message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line},
+                },
+            }],
+        })
+    return {
+        "$schema": ("https://json.schemastore.org/sarif-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "cloud_server_tpu.analysis",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
     }

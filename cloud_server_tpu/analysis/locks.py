@@ -368,15 +368,16 @@ class _ClassAnalysis:
 
     # -- rules --------------------------------------------------------------
 
-    def check(self) -> list[Finding]:
-        if not self.locks:
-            return []
-        self.scan()
-        must, may = self.propagate()
+    def infer_guards(self, must: dict[str, frozenset]
+                     ) -> tuple[dict[str, frozenset], list[Finding]]:
+        """Guarded-attribute inference from locked writes: every
+        ``self._*`` attribute written while a lock is must-held is
+        shared state, guarded by the INTERSECTION of the lock sets
+        across its locked writes. Returns ({attr: guard}, LD2
+        split-guard findings). Shared with the lifecycle pass, whose
+        LC4 torn-write rule consumes the same guard sets."""
         out: list[Finding] = []
         cls = self.node.name
-
-        # guarded-attribute inference from locked writes
         locked_writes: dict[str, list[frozenset]] = {}
         for m, ms in self.scans.items():
             for a in ms.accesses:
@@ -398,6 +399,15 @@ class _ClassAnalysis:
                     f"split guard: {attr} is written under "
                     f"{some} with no common lock — two writers can "
                     "race (LD2)"))
+        return guard, out
+
+    def check(self) -> list[Finding]:
+        if not self.locks:
+            return []
+        self.scan()
+        must, may = self.propagate()
+        cls = self.node.name
+        guard, out = self.infer_guards(must)
 
         rank = {name: i for i, name in enumerate(LOCK_ORDER)}
         for m, ms in self.scans.items():
@@ -436,6 +446,23 @@ class _ClassAnalysis:
                         f"violates the declared "
                         f"{' -> '.join(LOCK_ORDER)} order (LD4)"))
         return out
+
+
+def guarded_attributes(path: str, node: "ast.ClassDef"
+                       ) -> tuple[dict[str, frozenset],
+                                  dict[str, frozenset]]:
+    """({attr: guard-lock set}, {method: must-held set}) for one
+    class, or ({}, {}) when it owns no locks. The lifecycle pass's
+    LC4 torn-write rule imports THIS — both passes must agree on
+    which attributes are guarded shared state, or a rename would
+    silently drop an attribute from one audit but not the other."""
+    ca = _ClassAnalysis(path, node)
+    if not ca.locks:
+        return {}, {}
+    ca.scan()
+    must, _may = ca.propagate()
+    guard, _ld2 = ca.infer_guards(must)
+    return guard, must
 
 
 def check_source(path: str, source: str) -> list[Finding]:

@@ -2112,6 +2112,7 @@ class PagedInferenceServer:
         use_rows = bool(self._needs_rows[sl].any())
         use_bias = bool(self._has_bias[sl].any())
         use_grammar = bool((self._gid[sl] > 0).any())
+        # analysis: allow[lifecycle-discipline] a raise in the chunk's device work between the span append and the job removal is terminal for the replica — _fail_all clears _jobs and completes every slot, so the pair is never observed torn
         gid_g = jnp.asarray(pad_rows(self._gid[sl], 0))
         gst0_g = jnp.asarray(pad_rows(self._gstate0[sl], 0))
         use_lora = bool((self._aid[sl] > 0).any())

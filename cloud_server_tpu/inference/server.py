@@ -1505,6 +1505,7 @@ class InferenceServer:
             # injected dispatch failure: raises before any device work
             # (with a chunk possibly in flight the commit above already
             # ran, so no synced tokens are ever lost to the injection)
+            # analysis: allow[lifecycle-discipline] deliberate raise point: a dispatch fault fails the whole step and _fail_all tears every slot down, so the _iter_busy/_inflight pair is never read torn
             self._faults.check("dispatch")
         use_rows, use_bias = self._rows_mode()
         if prof is not None:

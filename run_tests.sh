@@ -145,14 +145,26 @@ if [ "$#" -eq 0 ]; then set -- -x -q; fi
 # ~instant), not only via tests/test_analysis.py: ALL passes run —
 # hot-path (per-iteration scheduler code free of device work/syncs/
 # allocation/wall-clock/I-O), lock-discipline (guarded-attribute and
-# _step_lock -> _lock ordering audit over the serving modules), and
+# _step_lock -> _lock ordering audit over the serving modules),
 # dispatch-discipline (one sanctioned device_get per iteration,
-# jax-free host-policy modules, bounded jit static args). The exit
-# code propagates, so a failure here reads as "serving invariant
-# regression", loudly, before any pytest output scrolls past.
+# jax-free host-policy modules, bounded jit static args), and
+# lifecycle-discipline (finish-exactly-once through _complete in the
+# documented terminal order, page-ownership balance on every edge,
+# no torn guarded writes across may-raise calls). The exit code
+# propagates, so a failure here reads as "serving invariant
+# regression", loudly, before any pytest output scrolls past. The
+# machine-readable report lands in a /tmp artifact so CI can upload
+# it (and render --sarif annotations) without re-running the suite.
 # Checker catalog + suppression-pragma syntax: docs/analysis.md.
+ANALYSIS_JSON="${ANALYSIS_JSON:-/tmp/cloud_server_tpu_analysis.json}"
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python -m cloud_server_tpu.analysis || exit $?
+    python -m cloud_server_tpu.analysis --json > "$ANALYSIS_JSON"
+arc=$?
+if [ "$arc" -ne 0 ]; then
+    # surface the findings on the console before failing the gate
+    cat "$ANALYSIS_JSON"
+    exit $arc
+fi
 
 shopt -s nullglob  # an empty group must not reach pytest as a literal
 rc=0

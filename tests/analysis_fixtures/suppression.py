@@ -3,7 +3,11 @@
 Two identical lock-discipline violations; ONE carries a reasoned
 ``allow[lock-discipline]`` pragma (and must be suppressed), the other
 must survive. A third pragma has no reason and must itself become a
-``pragma`` finding.
+``pragma`` finding. A fourth violation sits on a continuation line of
+a multi-line statement whose pragma is anchored on the statement's
+FIRST line — the regression for full-lexical-extent coverage (the
+finding reports at the sub-expression's line, lines below the
+pragma).
 """
 
 import threading
@@ -32,3 +36,11 @@ class Suppressed:
     def reasonless(self):
         # analysis: allow[lock-discipline]
         return self._state
+
+    def allowed_multiline(self):
+        with self._lock:
+            waits = [  # analysis: allow[lock-discipline] regression: the finding lands on the sleep's own line, below this pragma — statement-extent coverage must still suppress it
+                time.sleep(0.001),
+                time.sleep(0.002),
+            ]
+            return waits

@@ -1,25 +1,27 @@
 """The static-analysis gate: the multi-pass framework (registry,
 suppression pragmas, reporters) plus every checker's fixture
 round-trip — hot-path sync/allocation rules, lock discipline
-(LD1..LD4), and dispatch discipline (DD1..DD4). The whole suite must
-run clean over the real serving stack (suppressions honored), and
-each checker must actually catch each violation class. Stdlib-only:
-this file never imports jax (the fixtures mentioning jax are PARSED,
-never imported)."""
+(LD1..LD4), dispatch discipline (DD1..DD5), and lifecycle discipline
+(LC1..LC4). The whole suite must run clean over the real serving
+stack (suppressions honored), and each checker must actually catch
+each violation class. Stdlib-only: this file never imports jax (the
+fixtures mentioning jax are PARSED, never imported)."""
 
 import json
 import pathlib
 import re
 import subprocess
 import sys
+import time
 
 from cloud_server_tpu.analysis import (HOT_PATHS, Finding,
                                        apply_pragmas, check_hot_paths,
                                        check_source, collect_pragmas,
-                                       dispatch, locks,
+                                       dispatch, lifecycle, locks,
                                        registered_passes, report_json,
                                        run_analysis)
-from cloud_server_tpu.analysis.framework import pragma_lines
+from cloud_server_tpu.analysis.framework import (pragma_lines,
+                                                 report_sarif)
 
 _HERE = pathlib.Path(__file__).resolve().parent
 _FIXTURES = _HERE / "analysis_fixtures"
@@ -403,9 +405,10 @@ def test_missing_registration_anchors_at_enclosing_class():
 
 # -- framework --------------------------------------------------------------
 
-def test_pass_registry_has_all_three_checkers():
+def test_pass_registry_has_all_four_checkers():
     assert set(registered_passes()) == {
-        "hot-path", "lock-discipline", "dispatch-discipline"}
+        "hot-path", "lock-discipline", "dispatch-discipline",
+        "lifecycle-discipline"}
 
 
 def test_finding_renders_path_line_checker_symbol():
@@ -443,23 +446,75 @@ def test_run_analysis_checker_filter():
 
 def test_pragma_silences_exactly_one_finding():
     """The suppression fixture has two identical sleep-under-lock
-    violations; the reasoned pragma kills exactly the one it
-    annotates, and the reason-less pragma is itself a finding."""
+    violations in single-line statements; the reasoned pragma kills
+    exactly the one it annotates, the unannotated one survives, and
+    the reason-less pragma is itself a finding. (The multi-line case
+    is test_pragma_covers_multiline_statement_extent.)"""
     src = (_FIXTURES / "suppression.py").read_text()
     raw = locks.check_source("suppression.py", src)
     sleeps = [f for f in raw if "sleep" in f.message]
-    assert len(sleeps) == 2, [str(f) for f in raw]
+    assert len(sleeps) == 4, [str(f) for f in raw]
     pragmas, bad = collect_pragmas("suppression.py", src)
     kept, suppressed = apply_pragmas(pragma_lines(pragmas), raw)
-    assert len(suppressed) == 1
-    assert "sleep" in suppressed[0][0].message
-    assert "test fixture" in suppressed[0][1]
+    assert len(suppressed) == 3
+    assert all("sleep" in f.message for f, _ in suppressed)
+    assert any("test fixture" in reason for _, reason in suppressed)
     assert sum("sleep" in f.message for f in kept) == 1
     # the reason-less pragma is a `pragma` finding and suppresses
     # nothing: the LD1 read it sits above must survive in `kept`
     assert len(bad) == 1 and bad[0].checker == "pragma"
     assert any(f.checker == "lock-discipline" and "_state" in f.message
                for f in kept)
+
+
+def test_pragma_covers_multiline_statement_extent():
+    """Regression: findings anchor at SUB-EXPRESSION lines — a pragma
+    on a multi-line statement's first line must cover the whole
+    lexical extent, not just its own line."""
+    src = (_FIXTURES / "suppression.py").read_text()
+    raw = locks.check_source("suppression.py", src)
+    multiline = [f for f in raw
+                 if f.symbol == "Suppressed.allowed_multiline"]
+    assert len(multiline) == 2, [str(f) for f in raw]
+    pragmas, bad = collect_pragmas("suppression.py", src)
+    by_line = pragma_lines(pragmas)
+    pragma_of = [p for p in pragmas
+                 if "statement-extent" in p.reason][0]
+    # both findings land BELOW the pragma's own line, inside the
+    # statement's extent, and both are suppressed
+    for f in multiline:
+        assert f.line > pragma_of.line, (f.line, pragma_of.line)
+        assert f.line in by_line and f.checker in by_line[f.line]
+    kept, suppressed = apply_pragmas(by_line, multiline)
+    assert not kept and len(suppressed) == 2
+
+
+def test_pragma_inside_multiline_call_covers_the_call_line():
+    """A comment-only pragma BETWEEN the continuation lines of a
+    multi-line call (the paged server's grammar-table idiom) covers
+    the whole statement, including the call's first line where some
+    checkers anchor."""
+    src = ("def f(self):\n"
+           "    self.launch(\n"
+           "        self.a,\n"
+           "        # analysis: allow[hot-path] staged under _lock\n"
+           "        self.b,\n"
+           "    )\n")
+    pragmas, bad = collect_pragmas("x.py", src)
+    assert not bad
+    by_line = pragma_lines(pragmas)
+    for line in (2, 3, 4, 5, 6):
+        assert "hot-path" in by_line.get(line, {}), (line, by_line)
+
+
+def test_pragma_extent_survives_unparsable_source():
+    """A syntax-broken file degrades to line-anchored coverage, never
+    a traceback out of pragma collection."""
+    src = ("def broken(:\n"
+           "    x = 1  # analysis: allow[hot-path] still collected\n")
+    pragmas, bad = collect_pragmas("x.py", src)
+    assert not bad
+    assert len(pragmas) == 1 and pragmas[0].covers == (2,)
 
 
 def test_pragma_on_comment_line_covers_next_statement():
@@ -763,6 +818,160 @@ def test_dispatch_overlap_export_stays_out_of_plan_reach():
                 if f.symbol == "S._handoff_prefetch_fine"], msgs
 
 
+# -- lifecycle-discipline ---------------------------------------------------
+
+# fixture-local rosters for the lifecycle round-trips, mirroring how
+# the real rosters key on the audited modules
+_LC_GOOD_KW = dict(owner_funcs=("GoodOwner.retry",),
+                   marker_funcs=("GoodLifecycle.emit",),
+                   complete_funcs=("GoodLifecycle._complete",),
+                   transfer_funcs=("SlotRecord",))
+_LC_BAD_KW = dict(owner_funcs=(), marker_funcs=(),
+                  complete_funcs=("BadFinish._complete",),
+                  transfer_funcs=())
+
+
+def test_lifecycle_flags_each_violation_class():
+    """lifecycle_bad.py: one violation per method, each must fire —
+    LC1 (leak, path-sensitive early exit, double complete, rogue
+    _done.set/_on_done), LC2 (misordered and missing markers), LC3
+    (leak on return, leak on raise, dropped result, rebind while
+    live), LC4 (may-raise call and explicit raise between guarded
+    writes)."""
+    src = (_FIXTURES / "lifecycle_bad.py").read_text()
+    findings = lifecycle.check_source("lifecycle_bad.py", src,
+                                      **_LC_BAD_KW)
+    by_symbol = {}
+    for f in findings:
+        by_symbol.setdefault(f.symbol, []).append(f.message)
+    expected = {
+        "BadFinish.drop_on_floor": ("never reaches _complete", "LC1"),
+        "BadFinish.early_exit_leaks": ("return", "LC1"),
+        "BadFinish.double_complete": ("completed again", "LC1"),
+        "BadFinish.rogue_done_set": ("_done.set() outside", "LC1"),
+        "BadFinish.rogue_callback": ("_on_done is read", "LC1"),
+        "BadOrder._complete": ("runs before", "LC2"),
+        "BadMissing._complete": ("missing the _fail_handler", "LC2"),
+        "BadPages.leak_on_return": ("never releases", "LC3"),
+        "BadPages.leak_on_raise": ("raise", "LC3"),
+        "BadPages.drops_result": ("discarded", "LC3"),
+        "BadPages.rebinds_while_live": ("rebound", "LC3"),
+        "BadTear.risky_between": ("may-raise call open()", "LC4"),
+        "BadTear.raise_between": ("an explicit raise", "LC4"),
+    }
+    for symbol, (needle, rule) in expected.items():
+        msgs = by_symbol.get(symbol, [])
+        assert any(needle in m and rule in m for m in msgs), (
+            symbol, msgs or "NO FINDINGS")
+    # exactly one finding per violation method — no noise
+    assert set(by_symbol) == set(expected), sorted(by_symbol)
+    for symbol, msgs in by_symbol.items():
+        assert len(msgs) == 1, (symbol, msgs)
+
+
+def test_lifecycle_accepts_disciplined_fixture():
+    """lifecycle_good.py holds the compliant twin of every violation
+    (direct/transitive/deferred completion, sanctioned owner and
+    marker, balanced/transferred/returned pages, protected or
+    relocated risky work) — the checker must stay silent."""
+    src = (_FIXTURES / "lifecycle_good.py").read_text()
+    findings = lifecycle.check_source("lifecycle_good.py", src,
+                                      **_LC_GOOD_KW)
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def test_lifecycle_completion_via_call_graph():
+    """A path completing through a helper that transitively reaches
+    _complete (the class-local call-graph propagation) is clean; the
+    same path without the helper edge is a leak."""
+    good = (
+        "class S:\n"
+        "    def _complete(self, req):\n"
+        "        self.metrics.observe_finish(req)\n"
+        "        h = self._fail_handler\n"
+        "        req._done.set()\n"
+        "        cb = req._on_done\n"
+        "    def _finish(self, req):\n"
+        "        self._deactivate(req)\n"
+        "        self._complete(req)\n"
+        "    def expire(self, req):\n"
+        "        req.finish_reason = 'deadline'\n"
+        "        self._finish(req)\n")
+    assert not lifecycle.check_source(
+        "s.py", good, owner_funcs=(), marker_funcs=(),
+        complete_funcs=(), transfer_funcs=())
+    bad = good.replace("self._complete(req)",
+                       "self._deactivate(req)")
+    findings = lifecycle.check_source(
+        "s.py", bad, owner_funcs=(), marker_funcs=(),
+        complete_funcs=(), transfer_funcs=())
+    assert any("never reaches _complete" in f.message
+               for f in findings), [str(f) for f in findings]
+
+
+def test_lifecycle_roster_rot_is_a_finding():
+    """Roster entries that vanished, and entries whose sanctioned
+    behavior vanished (an owner without _done.set(), a marker that no
+    longer assigns finish_reason), must each surface."""
+    src = ("class R:\n"
+           "    def retry(self, orig):\n"
+           "        orig.cancel()\n"
+           "    def emit(self, req):\n"
+           "        return False\n")
+    findings = lifecycle.check_source(
+        "r.py", src,
+        owner_funcs=("R.retry", "R.gone"),
+        marker_funcs=("R.emit",),
+        complete_funcs=("R._complete",),
+        transfer_funcs=("RSlot",))
+    msgs = [f.message for f in findings]
+    assert any("R.gone" in m and "does not exist" in m
+               for m in msgs), msgs
+    assert any("no longer contains a _done.set()" in m
+               for m in msgs), msgs
+    assert any("no longer assigns finish_reason" in m
+               for m in msgs), msgs
+    assert any("COMPLETE_FUNCS" in m or "R._complete" in m
+               for m in msgs), msgs
+    assert any("RSlot" in m for m in msgs), msgs
+
+
+def test_lifecycle_rosters_cover_the_serving_stack():
+    """The real rosters stay anchored: the five lifecycle modules,
+    the router's completion owners, emit_token as the terminal
+    marker, both _complete bodies, and _Slot as the audited page
+    transferee. check_lifecycle over the repo is clean (deliberate
+    exceptions ride as pragmas, applied by run_analysis)."""
+    assert lifecycle.LIFECYCLE_ROSTER == (
+        "cloud_server_tpu/inference/paged_server.py",
+        "cloud_server_tpu/inference/server.py",
+        "cloud_server_tpu/inference/block_allocator.py",
+        "cloud_server_tpu/inference/migration.py",
+        "cloud_server_tpu/inference/router.py")
+    owners = lifecycle.COMPLETION_OWNER_FUNCS[
+        "cloud_server_tpu/inference/router.py"]
+    assert "ReplicatedRouter._retry_submit" in owners
+    assert "ReplicatedRouter._mirror_retry" in owners
+    assert lifecycle.TERMINAL_MARKER_FUNCS[
+        "cloud_server_tpu/inference/server.py"] == ("emit_token",)
+    assert lifecycle.OWNERSHIP_TRANSFER_FUNCS[
+        "cloud_server_tpu/inference/paged_server.py"] == ("_Slot",)
+    report = run_analysis(str(_HERE.parent),
+                          checkers=["lifecycle-discipline"])
+    assert report.ok, "\n".join(str(f) for f in report.findings)
+
+
+def test_analysis_latency_budget():
+    """The gate runs inside every test process AND as an explicit
+    run_tests.sh step: all passes over the full roster must finish
+    far under the tier-1 margin."""
+    t0 = time.perf_counter()
+    report = run_analysis(str(_HERE.parent))
+    elapsed = time.perf_counter() - t0
+    assert report.ok
+    assert elapsed < 10.0, f"analysis suite took {elapsed:.1f}s"
+
+
 # -- reporters / CLI --------------------------------------------------------
 
 def test_json_report_shape_is_stable():
@@ -800,6 +1009,65 @@ def test_cli_unknown_checker_is_usage_error():
         capture_output=True, text=True, cwd=str(_HERE.parent))
     assert out.returncode == 2
     assert "bogus" in out.stderr
+
+
+def test_cli_lifecycle_checker_filter_round_trip():
+    """--checker lifecycle-discipline runs ONLY the new pass over the
+    real stack and exits clean."""
+    out = subprocess.run(
+        [sys.executable, "-m", "cloud_server_tpu.analysis", "--json",
+         "--checker", "lifecycle-discipline", str(_HERE.parent)],
+        capture_output=True, text=True, cwd=str(_HERE.parent))
+    assert out.returncode == 0, out.stderr or out.stdout
+    doc = json.loads(out.stdout)
+    assert doc["checkers"] == ["lifecycle-discipline"]
+    assert doc["counts"]["findings"] == 0
+
+
+def test_cli_emits_sarif():
+    """--sarif writes a SARIF 2.1.0 document CI can render as code
+    annotations: schema/version pinned, one rule per checker."""
+    out = subprocess.run(
+        [sys.executable, "-m", "cloud_server_tpu.analysis", "--sarif",
+         str(_HERE.parent)],
+        capture_output=True, text=True, cwd=str(_HERE.parent))
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-2.1.0" in doc["$schema"]
+    (run,) = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "cloud_server_tpu.analysis"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rule_ids == set(registered_passes())
+    assert run["results"] == []  # clean tree: no annotations
+
+
+def test_sarif_results_carry_location_and_level():
+    """Findings map to SARIF results with ruleId, error level, and a
+    physical location (path + startLine) — the fields annotation
+    renderers key on."""
+    report = run_analysis(str(_HERE.parent))
+    fake = Finding("pkg/mod.py", 41, "lifecycle-discipline", "C.m",
+                   "boom (LC1)")
+    report.findings.append(fake)
+    doc = report_sarif(report)
+    (res,) = doc["runs"][0]["results"]
+    assert res["ruleId"] == "lifecycle-discipline"
+    assert res["level"] == "error"
+    assert "[C.m] boom (LC1)" == res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "pkg/mod.py"
+    assert loc["region"]["startLine"] == 41
+    assert json.loads(json.dumps(doc)) == doc
+
+
+def test_cli_json_and_sarif_are_mutually_exclusive():
+    out = subprocess.run(
+        [sys.executable, "-m", "cloud_server_tpu.analysis", "--json",
+         "--sarif", str(_HERE.parent)],
+        capture_output=True, text=True, cwd=str(_HERE.parent))
+    assert out.returncode == 2
+    assert "not allowed with" in out.stderr
 
 
 # -- docs drift -------------------------------------------------------------
