@@ -71,6 +71,9 @@ import time
 import jax
 import jax.numpy as jnp
 
+from cloud_server_tpu.utils.bench_helpers import make_prompt_fn, pct, top_up
+
+
 def _baseline_tokens_per_sec() -> tuple[str, float]:
     """(round_tag, tokens/s) of the latest BENCH_r*.json present — so
     vs_baseline is a round-over-round ratio and a regression shows up as
@@ -407,6 +410,14 @@ def serving_bench():
         print(f"[serving_bench] anomaly_forensics skipped after "
               f"error: {exc!r}", flush=True)
         out["anomaly_forensics_error"] = repr(exc)[:160]
+    # SLO-burn autoscaler vs static fleet on the diurnal-burst scenario
+    # (same guard discipline)
+    try:
+        out.update(_slo_autoscale_bench(params_bf16, base, infer_cfg))
+    except Exception as exc:  # noqa: BLE001
+        print(f"[serving_bench] slo_autoscale skipped after "
+              f"error: {exc!r}", flush=True)
+        out["slo_autoscale_error"] = repr(exc)[:160]
     return out
 
 
@@ -575,8 +586,6 @@ def _anomaly_forensics_bench(params, base, infer_cfg):
         retained tree gap-free (phase spans contiguous)."""
     import dataclasses
 
-    import numpy as np
-
     from cloud_server_tpu.inference.faults import FaultPlan
     from cloud_server_tpu.inference.paged_server import PagedInferenceServer
     from cloud_server_tpu.inference.request_trace import PHASES
@@ -596,16 +605,13 @@ def _anomaly_forensics_bench(params, base, infer_cfg):
         page_size=128, prefill_chunk=256, decode_chunk=8,
         prompt_buckets=[64, 256], scheduler="mixed",
         anomaly=anomaly_cfg, faults=fp)
-    rng = np.random.RandomState(0)
+    mk_prompt = make_prompt_fn(0)
 
-    def mk_prompt(n):
-        return [int(x) for x in rng.randint(1, 30000, size=n)]
-
-    def top_up():
+    def feed():
         # the watchdog only observes BUSY iterations, so keep the
-        # scheduler fed (window close needs observed time to pass)
-        if not (srv._jobs or srv.num_pending or srv.num_active):
-            srv.submit(mk_prompt(64), max_new_tokens=256)
+        # scheduler fed (window close needs observed time to pass) —
+        # the shared top-up helper (utils/bench_helpers)
+        top_up(srv, mk_prompt)
 
     # background churn flood at 1% head sampling; a few steps compile
     # every shape before the timed incident rounds
@@ -626,7 +632,7 @@ def _anomaly_forensics_bench(params, base, infer_cfg):
         t0 = time.perf_counter()
         steps = 0
         while time.perf_counter() - t0 < 60.0:
-            top_up()
+            feed()
             srv.step()
             steps += 1
             fired = sum(srv.anomaly_stats()["fired_total"].values())
@@ -640,7 +646,7 @@ def _anomaly_forensics_bench(params, base, infer_cfg):
         t_close = time.perf_counter()
         while (srv.anomaly_stats()["active"]
                and time.perf_counter() - t_close < 60.0):
-            top_up()
+            feed()
             srv.step()
     assert len(detect_ms) == 3, (
         f"watchdog latched {len(detect_ms)}/3 incident rounds")
@@ -684,10 +690,6 @@ def _anomaly_forensics_bench(params, base, infer_cfg):
     tstats = srv.tail_trace_stats()
     srv.stop()
 
-    def pct(xs, p):
-        xs = sorted(xs)
-        return xs[min(len(xs) - 1, int(p * len(xs)))]
-
     out = {"churn_tail_traces_retained_frac": frac,
            "anomaly_detect_ms_p50": pct(detect_ms, 0.50),
            "anomaly_detect_iters_max": max(detect_steps),
@@ -698,6 +700,196 @@ def _anomaly_forensics_bench(params, base, infer_cfg):
           f"{out['anomaly_detect_ms_p50']:.1f} ms "
           f"(<= {out['anomaly_detect_iters_max']} iters), "
           f"{len(bundles)} bundles, tail retained frac {frac:.2f}",
+          flush=True)
+    return out
+
+
+def _slo_autoscale_bench(params, base, infer_cfg):
+    """SLO-burn autoscaler vs static fleet on the canonical
+    quiet->burst->quiet diurnal scenario (scenarios.diurnal_burst),
+    replayed by the scenario harness against two live fleets:
+
+      * AUTOSCALED — starts at min_replicas=1 with a warm pool of
+        spares; the SLOBurnAutoscaler polls fleet burn rates +
+        pending depth and calls add_replica/remove_replica(migrate).
+      * STATIC — a fixed fleet sized to the autoscaled arm's AVERAGE
+        footprint rounded UP (ceil of chip-seconds / wall time), so
+        the control spends at least as many chip-seconds. Equal-ish
+        chip-seconds is the fairness control: the autoscaler's only
+        edge is placing capacity WHEN the burst needs it.
+
+    Reported: per-arm interactive attainment (worst lifetime metric
+    from slo_report, removed replicas' trackers merged back in so
+    scale-downs cannot drop history), chip-seconds, scale-up/down
+    counts, and time-to-recover (burst start -> first scale-up).
+
+    ASSERTS the acceptance bar: autoscaled interactive attainment >=
+    static at chip-seconds <= static x 1.05, at least one scale-up
+    AND one scale-down actually fired, and ZERO lost requests — every
+    fired event completes (scale-down drains migrate, never drop)."""
+    import dataclasses
+    import math
+
+    from cloud_server_tpu.inference.paged_server import PagedInferenceServer
+    from cloud_server_tpu.inference.router import ReplicatedRouter
+    from cloud_server_tpu.inference.slo import merge_reports
+    from cloud_server_tpu.scenarios import (AutoscalerConfig, ReplayDriver,
+                                            SLOBurnAutoscaler, TenantMix,
+                                            diurnal_burst)
+
+    # same rationale as _disagg_bench: the A/B contrast is within-run,
+    # so xla off-TPU keeps the CPU-sandbox asserts tractable
+    impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    cfg = dataclasses.replace(base, decode_attention_impl=impl)
+    qos_cfg = {"quantum": 64,
+               "tenants": {
+                   "inter": {"weight": 4.0, "priority": "interactive"},
+                   "bulk": {"weight": 1.0, "priority": "batch"}}}
+    # short windows so burn reacts within a ~40 s bench; targets sized
+    # to pass when a request is served promptly and fail when it sits
+    # behind an unscaled burst backlog
+    slo_cfg = {"windows_s": [5, 15],
+               "classes": {
+                   "interactive": {"objective": 0.9, "ttft_s": 6.0,
+                                   "queue_wait_s": 5.0, "itl_s": 3.0,
+                                   "e2e_s": 60.0},
+                   "batch": {"objective": 0.5, "ttft_s": 20.0,
+                             "e2e_s": 120.0}}}
+    phase_s = 12.0
+    scenario = diurnal_burst(
+        seed=0, duration_s=3 * phase_s, phase_s=phase_s,
+        low_rps=0.2, high_rps=3.0,
+        tenants=TenantMix({"inter": 3.0, "bulk": 1.0}))
+
+    def mk():
+        return PagedInferenceServer(
+            params, cfg, infer_cfg, max_slots=8, max_context=1024,
+            page_size=128, prefill_chunk=256, decode_chunk=8,
+            prompt_buckets=[64, 256], qos=qos_cfg, slo=slo_cfg)
+
+    def interactive_attainment(reports) -> float:
+        rep = merge_reports(reports)
+        centry = (rep or {}).get("classes", {}).get("interactive")
+        if not centry:
+            return 1.0
+        vals = [m["lifetime"]["attainment"]
+                for m in centry["metrics"].values()
+                if m["lifetime"]["total"]]
+        return min(vals) if vals else 1.0
+
+    def run_arm(n_start, asc_pool):
+        router = ReplicatedRouter([mk() for _ in range(n_start)])
+        released = []
+        asc = None
+        if asc_pool is not None:
+            spares = [mk() for _ in range(asc_pool)]
+            asc = SLOBurnAutoscaler(
+                router, spawn=lambda role: (spares.pop()
+                                            if spares else None),
+                release=released.append,
+                config=AutoscalerConfig(
+                    min_replicas=1, max_replicas=1 + asc_pool,
+                    classes=("interactive", "batch", "default"),
+                    up_fast_burn=1.5, up_slow_burn=1.0,
+                    down_fast_burn=0.5, down_slow_burn=0.5,
+                    pending_high=4.0, pending_low=1.0,
+                    hold_s=4.0, poll_s=0.5, drain_timeout_s=60.0))
+        drv = ReplayDriver(router, scenario.generate())
+        state = {"t": time.monotonic(), "chips": 0.0, "poll": 0.0}
+        t_start = state["t"]
+
+        def pump():
+            router.step()
+            now = time.monotonic()
+            state["chips"] += (len(router.attached_indices())
+                               * (now - state["t"]))
+            state["t"] = now
+            if asc is not None and now - state["poll"] >= asc.cfg.poll_s:
+                state["poll"] = now
+                asc.step(now)
+
+        res = drv.run(step=pump, timeout_s=600.0)
+        router.run_until_idle()
+        # the chip-second account covers the SERVING window only (both
+        # arms pay for capacity held while requests could arrive/run);
+        # freeze it here so the settle wait below is not billed
+        t_end = time.monotonic()
+        state["chips"] += (len(router.attached_indices())
+                           * (t_end - state["t"]))
+        state["t"] = t_end
+        chips = state["chips"]
+        elapsed = t_end - t_start
+        # post-drain settle: let the quiet tail's scale-down land (the
+        # burn windows need wall time to age the burst out)
+        if asc is not None:
+            t_settle = time.monotonic()
+            while (len(router.attached_indices()) > 1
+                   and time.monotonic() - t_settle < 30.0):
+                pump()
+                time.sleep(0.05)
+        reports = [r.slo_report() for r in router.replicas
+                   if hasattr(r, "slo_report")]
+        # scale-downs detach trackers from the fleet report — merge the
+        # released replicas back so attainment covers EVERY request
+        reports += [r.slo_report() for r in released]
+        att = interactive_attainment(reports)
+        stats = asc.stats() if asc is not None else None
+        events = list(asc.events) if asc is not None else []
+        if asc is not None:
+            asc.stop()
+        for r in released:
+            r.stop()
+        router.stop()
+        return {"res": res, "att": att, "chips": chips,
+                "elapsed": elapsed, "stats": stats, "events": events,
+                "t_start": t_start}
+
+    # one throwaway replica warms the jit cache so neither arm pays
+    # compile time inside its measured window
+    warm = mk()
+    mk_prompt = make_prompt_fn(0)
+    warm.submit(mk_prompt(64), max_new_tokens=8, tenant="inter")
+    warm.submit(mk_prompt(200), max_new_tokens=8, tenant="bulk")
+    warm.run_until_idle()
+    warm.stop()
+
+    auto = run_arm(1, asc_pool=2)
+    n_static = max(1, math.ceil(auto["chips"] / auto["elapsed"] - 1e-6))
+    static = run_arm(n_static, asc_pool=None)
+
+    ups = [e for e in auto["events"] if e.action == "up"]
+    downs = [e for e in auto["events"] if e.action == "down"]
+    recover_s = (max(0.0, ups[0].t - (auto["t_start"] + phase_s))
+                 if ups else -1.0)
+    out = {
+        "slo_autoscale_auto_attainment": auto["att"],
+        "slo_autoscale_static_attainment": static["att"],
+        "slo_autoscale_auto_chip_s": auto["chips"],
+        "slo_autoscale_static_chip_s": static["chips"],
+        "slo_autoscale_static_replicas": n_static,
+        "slo_autoscale_scale_ups": len(ups),
+        "slo_autoscale_scale_downs": len(downs),
+        "slo_autoscale_time_to_recover_s": recover_s,
+        "slo_autoscale_lost_requests": (auto["res"]["failed"]
+                                        + auto["res"]["outstanding"]
+                                        + auto["res"]["rejected"]),
+    }
+    assert out["slo_autoscale_lost_requests"] == 0, (
+        f"autoscaled arm lost requests: {auto['res']}")
+    assert ups and downs, (
+        f"autoscaler never cycled: {len(ups)} ups, {len(downs)} downs "
+        f"(events: {[e.to_json() for e in auto['events']]})")
+    assert auto["att"] >= static["att"], (
+        f"autoscaled interactive attainment {auto['att']:.3f} < static "
+        f"{static['att']:.3f} at n_static={n_static}")
+    assert auto["chips"] <= static["chips"] * 1.05, (
+        f"autoscaled burned more chip-seconds ({auto['chips']:.1f}) "
+        f"than the static control ({static['chips']:.1f})")
+    print(f"[serving_bench] slo_autoscale: auto attain "
+          f"{auto['att']:.3f} ({auto['chips']:.0f} chip-s, "
+          f"{len(ups)} up/{len(downs)} down, recover "
+          f"{recover_s:.1f} s) vs static[{n_static}] "
+          f"{static['att']:.3f} ({static['chips']:.0f} chip-s)",
           flush=True)
     return out
 
@@ -731,8 +923,6 @@ def _disagg_bench(params, base, infer_cfg):
     measured), like the other serving A/Bs."""
     import dataclasses
 
-    import numpy as np
-
     from cloud_server_tpu.inference.paged_server import PagedInferenceServer
     from cloud_server_tpu.inference.request_trace import PHASES
     from cloud_server_tpu.inference.router import ReplicatedRouter
@@ -756,10 +946,7 @@ def _disagg_bench(params, base, infer_cfg):
                 prompt_buckets=[64, 256], qos=qos_cfg, tracing=1.0)
 
         router = ReplicatedRouter([mk(), mk()], roles=roles)
-        rng = np.random.RandomState(0)
-
-        def mk_prompt(n):
-            return [int(x) for x in rng.randint(1, 30000, size=n)]
+        mk_prompt = make_prompt_fn(0)
 
         def handoffs_attempted():
             return router.metrics_snapshot()[
@@ -799,18 +986,13 @@ def _disagg_bench(params, base, infer_cfg):
             router.step()
             steps += 1
 
-        def pooled_p99(vals):
-            vals = sorted(vals)
-            return vals[min(len(vals) - 1, int(0.99 * len(vals)))] \
-                if vals else 0.0
-
         itl = [b - a for r in inter
                for a, b in zip(r.emit_times, r.emit_times[1:])]
         ttft = [r.emit_times[0] - r.submit_time for r in inter
                 if r.emit_times]
         reqs = inter + flood
-        res = {"itl_ms_p99": pooled_p99(itl) * 1e3,
-               "ttft_ms_p99": pooled_p99(ttft) * 1e3,
+        res = {"itl_ms_p99": pct(itl, 0.99) * 1e3,
+               "ttft_ms_p99": pct(ttft, 0.99) * 1e3,
                "completed_frac": sum(r.finish_reason == "length"
                                      for r in reqs) / len(reqs)}
         if roles is not None:
@@ -1274,8 +1456,6 @@ _CHURN_SLO_CFG = {
 def _churn_scenario(params, base, infer_cfg, scheduler, overlap=None):
     import dataclasses
 
-    import numpy as np
-
     from cloud_server_tpu.inference.paged_server import PagedInferenceServer
 
     cfg = dataclasses.replace(base, decode_attention_impl="pallas")
@@ -1293,10 +1473,7 @@ def _churn_scenario(params, base, infer_cfg, scheduler, overlap=None):
             page_size=128, prefill_chunk=256, decode_chunk=8,
             prompt_buckets=[64, 256, 512], scheduler=scheduler,
             overlap=overlap, tracing=1.0, slo=_CHURN_SLO_CFG)
-        rng = np.random.RandomState(0)
-
-        def mk_prompt(n):
-            return [int(x) for x in rng.randint(1, 30000, size=n)]
+        mk_prompt = make_prompt_fn(0)
 
         first = [srv.submit(mk_prompt(64), max_new_tokens=256)
                  for _ in range(8)]
@@ -1339,10 +1516,6 @@ def _churn_scenario(params, base, infer_cfg, scheduler, overlap=None):
      snap, flight, slo_rep) = scenario()
 
     total = sum(len(r.tokens) for r in first + waves)
-
-    def pct(xs, p):
-        xs = sorted(xs)
-        return xs[min(len(xs) - 1, int(p * len(xs)))]
 
     ttfts = [r.emit_times[0] - r.submit_time
              for r in waves if r.emit_times]
@@ -1456,8 +1629,6 @@ def _qos_isolation_bench(params, base, infer_cfg):
     twice (untimed compile warm-up, then timed) like the churn bench."""
     import dataclasses
 
-    import numpy as np
-
     from cloud_server_tpu.inference.paged_server import PagedInferenceServer
 
     cfg = dataclasses.replace(base, decode_attention_impl="pallas")
@@ -1485,10 +1656,7 @@ def _qos_isolation_bench(params, base, infer_cfg):
             page_size=128, prefill_chunk=256, decode_chunk=8,
             prompt_buckets=[64, 256], num_pages=72, qos=qos,
             slo=slo_cfg)
-        rng = np.random.RandomState(0)
-
-        def mk_prompt(n):
-            return [int(x) for x in rng.randint(1, 30000, size=n)]
+        mk_prompt = make_prompt_fn(0)
 
         victims = [srv.submit(mk_prompt(64), max_new_tokens=512,
                               tenant="inter") for _ in range(6)]

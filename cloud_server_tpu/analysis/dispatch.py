@@ -231,6 +231,14 @@ HOST_POLICY_MODULES: tuple[str, ...] = (
     "cloud_server_tpu/inference/cache_telemetry.py",
     "cloud_server_tpu/inference/anomaly.py",
     "cloud_server_tpu/utils/serving_metrics.py",
+    # scenario harness: workload generation, replay, the discrete-event
+    # simulator, and the autoscaler are all pure host policy — the
+    # simulator MODELS device iterations from fitted flight-record
+    # costs, it must never run one
+    "cloud_server_tpu/scenarios/workload.py",
+    "cloud_server_tpu/scenarios/replay.py",
+    "cloud_server_tpu/scenarios/simulator.py",
+    "cloud_server_tpu/scenarios/autoscaler.py",
 )
 
 # Call leaves whose results are statically bounded REGARDLESS of their

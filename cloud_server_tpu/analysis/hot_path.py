@@ -214,6 +214,24 @@ HOT_PATHS: dict[str, tuple[str, ...]] = {
         "TenantRegistry.fair_shares",
         "TenantRegistry._fair_shares_locked",
     ),
+    # scenario replay: tick()/_fire() interleave with scheduler step()
+    # pumping on the serving thread — the caller owns time (tick takes
+    # `now`), so a clock read, sleep, or log line here would skew the
+    # very replay timings the harness measures. run()/result() are the
+    # wall-clock convenience/read paths and deliberately absent.
+    "cloud_server_tpu/scenarios/replay.py": (
+        "ReplayDriver.tick",
+        "ReplayDriver._fire",
+    ),
+    # autoscaler decision path: evaluate()/_burn_signal() run per poll
+    # under the autoscaler lock while submit threads contend for the
+    # router — pure decision on a caller-passed clock. The actuation
+    # paths (_scale_up/_scale_down, which legitimately log and drain)
+    # are deliberately absent.
+    "cloud_server_tpu/scenarios/autoscaler.py": (
+        "SLOBurnAutoscaler.evaluate",
+        "SLOBurnAutoscaler._burn_signal",
+    ),
     "cloud_server_tpu/utils/serving_metrics.py": (
         "Counter.inc",
         "Gauge.set",

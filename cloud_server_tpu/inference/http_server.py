@@ -51,6 +51,11 @@ Endpoints:
                     (inference/slo.py; {"enabled": false} without an
                     SLO config). Behind a ReplicatedRouter the counts
                     merge fleet-wide.
+  GET  /autoscaler  The SLO-burn autoscaler's live view (fleet size,
+                    burn signal, scale-event tail) when one is
+                    attached to the router (scenarios/autoscaler.py);
+                    {"enabled": false} otherwise. /stats carries the
+                    same block under "autoscaler".
   GET  /debug/requests/<id>  Span tree of one sampled request
                     (inference/request_trace.py): queue / prefill /
                     decode / preempt_gap / emit phases plus
@@ -469,6 +474,13 @@ class HttpFrontend:
                     rep = fn() if fn is not None else None
                     self._json(200, rep if rep is not None
                                else {"enabled": False})
+                elif url.path == "/autoscaler":
+                    # scenario-harness hook: the SLO-burn autoscaler's
+                    # live view (scenarios/autoscaler.py attaches it
+                    # to the router it scales)
+                    asc = getattr(front.srv, "autoscaler", None)
+                    self._json(200, asc.stats() if asc is not None
+                               else {"enabled": False})
                 elif url.path == "/traces":
                     fn = getattr(front.srv, "trace_trees", None)
                     if fn is None:
@@ -772,6 +784,11 @@ class HttpFrontend:
         brfn = getattr(self.srv, "breaker_states", None)
         if brfn is not None:
             payload["breakers"] = brfn()
+        # SLO-burn autoscaler (scenarios/autoscaler.py attaches itself
+        # to the router): fleet size, burn signal, scale-event tail
+        asc = getattr(self.srv, "autoscaler", None)
+        if asc is not None:
+            payload["autoscaler"] = asc.stats()
         # replica role map (disaggregated prefill/decode fleets; all
         # "colocated" when no roles are configured)
         rfn = getattr(self.srv, "replica_roles", None)

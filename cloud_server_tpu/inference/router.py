@@ -89,6 +89,36 @@ class _Breaker:
         self.probing = False    # half_open: a probe submit is in flight
 
 
+class _DetachedSlot:
+    """Tombstone occupying a removed replica's index. The per-replica
+    arrays (`replicas`/`roles`/`_inflight`/`_breakers`/...) are
+    indexed by position everywhere — submits capture an index, then
+    call into it AFTER the router lock is released — so removal must
+    never shift indices. A detached slot is permanently unready and
+    empty; a racing submit that captured the index before detachment
+    gets a RuntimeError from `submit()` and fails over like any other
+    server-class refusal. `add_replica` reuses detached indices, so a
+    scale-up/scale-down cycle does not grow the arrays without
+    bound."""
+
+    ready = False
+    num_active = 0
+    num_pending = 0
+    tokens_emitted = 0
+
+    def submit(self, prompt, **kw):
+        raise RuntimeError("replica detached (removed from the fleet)")
+
+    def step(self) -> int:
+        return 0
+
+    def start(self):
+        return self
+
+    def stop(self, *a, **kw) -> None:
+        pass
+
+
 class ReplicatedRouter:
     """Route requests across independent serving replicas, with
     per-replica circuit breakers and failover retry.
@@ -180,6 +210,12 @@ class ReplicatedRouter:
         # held across the replica's submit() — that can block on model
         # work — so the counter is what bridges the window)
         self._inflight = [0] * len(self.replicas)
+        # indices whose replica was removed at runtime (remove_replica):
+        # tombstoned, never picked, reusable by add_replica; _removing
+        # marks an in-progress removal so two removers cannot claim
+        # one slot
+        self._detached: set[int] = set()
+        self._removing: set[int] = set()
         self.breaker_threshold = int(breaker_threshold)
         self.breaker_reset_s = float(breaker_reset_s)
         self._breakers = [_Breaker() for _ in self.replicas]
@@ -350,6 +386,10 @@ class ReplicatedRouter:
         decode replica afterward — batch/best_effort decode where
         they prefilled, soaking prefill-replica slack instead of
         polluting the low-latency decode pool."""
+        # analysis: allow[lock-discipline] GIL-atomic bool: topology
+        # flips only inside add/remove_replica under _lock; a stale
+        # read routes one request with the old topology, which the
+        # failover/handoff paths tolerate by design
         if not self._disagg:
             return None, False
         cls = "interactive"
@@ -396,15 +436,18 @@ class ReplicatedRouter:
         # resubmitting to the replica that just failed the request
         # would retry into the same failure.
         now = time.monotonic()
-        ready = [j for j, r in enumerate(self.replicas)
-                 if j not in exclude and getattr(r, "ready", True)]
+        # a detached (removed) slot is out of EVERY tier, including
+        # the last-resort fallback: there is no replica behind it
+        alive = [j for j in range(n) if j not in self._detached]
+        ready = [j for j in alive
+                 if j not in exclude
+                 and getattr(self.replicas[j], "ready", True)]
         cands = ([j for j in ready
                   if self._breaker_admits_locked(j, now)] or ready)
         if not cands:
             if strict:
                 return None
-            cands = ([j for j in range(n) if j not in exclude]
-                     or list(range(n)))
+            cands = ([j for j in alive if j not in exclude] or alive)
         # role preference narrows AFTER health (a healthy off-role
         # replica beats a broken on-role one — see _role_candidates)
         cands = self._role_candidates(cands, role)
@@ -476,12 +519,16 @@ class ReplicatedRouter:
                 i = self._pick(tenant=kw.get("tenant"),
                                count_inflight=True, exclude=excluded,
                                role=role)
+            # analysis: allow[lock-discipline] GIL-atomic list index:
+            # capability slots are written once at attach under _lock
+            # and i came from _pick — a read racing an attach at worst
+            # skips the hook for that one request
             hkw = ({"fail_handler": self._make_fail_hook(
                         i, prompt, dict(kw), frozenset(excluded),
                         None)}
                    if self._accepts_hook[i] else {})
             if (arm_handoff and self.roles[i] == ROLE_PREFILL
-                    and self._accepts_handoff[i]
+                    and self._accepts_handoff[i]  # analysis: allow[lock-discipline] GIL-atomic capability slot, see hkw above
                     and hasattr(self.replicas[i], "migrate_export")):
                 # prefill landed on a prefill replica: ride the
                 # handoff hook IN through submit (same no-install-
@@ -637,6 +684,8 @@ class ReplicatedRouter:
                                strict=True)
             if i is None:
                 break  # nothing healthy left: the failure stands
+            # analysis: allow[lock-discipline] GIL-atomic capability
+            # slot (written once at attach under _lock), as in submit
             hkw = ({"fail_handler": self._make_fail_hook(
                         i, prompt, dict(kw), frozenset(excluded),
                         orig)}
@@ -738,6 +787,8 @@ class ReplicatedRouter:
                 if len(excluded) >= len(self.replicas):
                     break
                 continue
+            # analysis: allow[lock-discipline] GIL-atomic capability
+            # slot (written once at attach under _lock), as in submit
             hook = (self._make_fail_hook(
                         i, list(snap.prompt), dict(kw),
                         frozenset(excluded), orig)
@@ -801,6 +852,9 @@ class ReplicatedRouter:
         ENQUEUES — the scheduler thread must never block on another
         replica's admission path."""
         def hook(req) -> None:
+            # analysis: allow[lock-discipline] GIL-atomic reference
+            # snapshot: the queue is created once on the disagg
+            # transition under _lock and never replaced
             q = self._handoff_q
             if q is not None:
                 q.put((req, replica, kw))
@@ -811,6 +865,9 @@ class ReplicatedRouter:
         time. A handoff is an OPTIMIZATION: any exception leaves the
         request decoding where it prefilled (or, after a successful
         export, the loop inside _handoff_one owns re-admission)."""
+        # analysis: allow[lock-discipline] GIL-atomic reference
+        # snapshot: the worker thread starts under _lock strictly
+        # after the queue exists, and the queue is never replaced
         q = self._handoff_q
         while True:
             item = q.get()
@@ -884,6 +941,8 @@ class ReplicatedRouter:
                 self._release_probe(i)
                 excluded.add(i)
                 continue
+            # analysis: allow[lock-discipline] GIL-atomic capability
+            # slot (written once at attach under _lock), as in submit
             hook = (self._make_fail_hook(
                         i, list(snap.prompt), dict(kw),
                         frozenset(excluded), orig)
@@ -1039,6 +1098,8 @@ class ReplicatedRouter:
             now = time.monotonic()
             out = []
             for i, b in enumerate(self._breakers):
+                if i in self._detached:
+                    continue
                 self._breaker_admits_locked(i, now)
                 out.append({
                     "replica": i, "role": self.roles[i],
@@ -1241,6 +1302,8 @@ class ReplicatedRouter:
             if tree is not None:
                 tree["root"]["tags"].setdefault("replica", i)
                 break
+        # analysis: allow[lock-discipline] racy-by-design monitoring
+        # read of a GIL-atomic bool (flips under _lock)
         if tree is None or not self._disagg:
             return tree
         for t in self.trace_trees():
@@ -1267,6 +1330,8 @@ class ReplicatedRouter:
             for tree in fn(n):
                 tree["root"]["tags"].setdefault("replica", i)
                 out.append(tree)
+        # analysis: allow[lock-discipline] racy-by-design monitoring
+        # read of a GIL-atomic bool (flips under _lock)
         if self._disagg:
             from cloud_server_tpu.inference.request_trace import (
                 merge_handoff_trees)
@@ -1345,6 +1410,8 @@ class ReplicatedRouter:
             for tree in fn(n):
                 tree["root"]["tags"].setdefault("replica", i)
                 out.append(tree)
+        # analysis: allow[lock-discipline] racy-by-design monitoring
+        # read of a GIL-atomic bool (flips under _lock)
         if self._disagg:
             from cloud_server_tpu.inference.request_trace import (
                 merge_handoff_trees)
@@ -1440,6 +1507,190 @@ class ReplicatedRouter:
             r.start()
         return self
 
+    # -- runtime fleet mutation ---------------------------------------------
+
+    def attached_indices(self) -> list[int]:
+        """Indices currently backed by a live replica (detached
+        tombstones excluded) — the autoscaler's fleet-size view."""
+        with self._lock:
+            return [i for i in range(len(self.replicas))
+                    if i not in self._detached]
+
+    def _set_role_gauge_locked(self, i: int, old_role: str | None,
+                               new_role: str | None) -> None:
+        """Move the constant role gauge to the slot's current role
+        (labeled series persist once created, so the stale label must
+        be zeroed, not abandoned at 1)."""
+        help_text = ("Replica role assignment (constant 1; the role "
+                     "rides the labels)")
+        if old_role is not None and old_role != new_role:
+            self._registry.gauge(
+                "router_replica_role", help_text,
+                labels={"replica": str(i), "role": old_role}).set(0)
+        if new_role is not None:
+            self._registry.gauge(
+                "router_replica_role", help_text,
+                labels={"replica": str(i), "role": new_role}).set(1)
+
+    def _recompute_disagg_locked(self) -> None:
+        attached_roles = {self.roles[i]
+                          for i in range(len(self.replicas))
+                          if i not in self._detached}
+        was = self._disagg
+        self._disagg = (ROLE_PREFILL in attached_roles
+                        and ROLE_DECODE in attached_roles)
+        if self._disagg and not was and self._handoff_thread is None:
+            # the fleet just became disaggregated at runtime: start
+            # the handoff worker the constructor would have started
+            self._handoff_q = queue.SimpleQueue()
+            self._handoff_thread = threading.Thread(
+                target=self._handoff_worker, daemon=True,
+                name="router-handoff")
+            self._handoff_thread.start()
+        # a fleet that DEGRADED out of disaggregation (one side
+        # removed) keeps its worker parked on the queue — harmless,
+        # and re-adding the role reuses it
+
+    def add_replica(self, replica, *, role: str = ROLE_COLOCATED) -> int:
+        """Attach a replica to the serving fleet AT RUNTIME (the
+        autoscaler's scale-up actuator; equally an operator handing a
+        warm standby to a live router). Returns the replica's index.
+
+        Registration matches the constructor: fresh breaker, role +
+        breaker-state gauges, failover/handoff capability probes.
+        Detached indices (prior `remove_replica`) are reused before
+        the arrays grow. A quiesced replica (a drained one coming
+        back from a warm pool) is `resume()`d so it accepts work the
+        moment placement can see it.
+
+        Roles: unlike the constructor — which validates the INITIAL
+        fleet shape — incremental adds accept any valid role;
+        disaggregated routing switches on automatically once the
+        attached fleet has both a 'prefill' and a 'decode' replica."""
+        if role not in _VALID_ROLES:
+            raise ValueError(f"unknown replica role {role!r}; valid: "
+                             f"{sorted(_VALID_ROLES)}")
+        # capability probes (inspect.signature) stay outside the lock
+        takes_hook = self._submit_takes_hook(replica)
+        takes_handoff = self._submit_takes_hook(replica, "handoff")
+        if (not getattr(replica, "ready", True)
+                and hasattr(replica, "resume")):
+            replica.resume()
+        with self._lock:
+            if self._detached:
+                i = min(self._detached)
+                self._detached.discard(i)
+                old_role = self.roles[i]
+                self.replicas[i] = replica
+                self.roles[i] = role
+                self._inflight[i] = 0
+                self._breakers[i] = _Breaker()
+                self._accepts_hook[i] = takes_hook
+                self._accepts_handoff[i] = takes_handoff
+            else:
+                i = len(self.replicas)
+                old_role = None
+                self.replicas.append(replica)
+                self.roles.append(role)
+                self._inflight.append(0)
+                self._breakers.append(_Breaker())
+                self._accepts_hook.append(takes_hook)
+                self._accepts_handoff.append(takes_handoff)
+                self._registry.gauge(
+                    "router_breaker_state",
+                    "Per-replica breaker state (0 closed, 1 "
+                    "half_open, 2 open)",
+                    labels={"replica": str(i)}).set(0)
+            self._set_role_gauge_locked(i, old_role, role)
+            self._recompute_disagg_locked()
+        _log.info("replica %d attached (role=%s, fleet size %d)",
+                  i, role, len(self.attached_indices()))
+        return i
+
+    def _quiesce_for_removal(self, replica_index: int, *,
+                             timeout: float | None,
+                             migrate: bool) -> bool:
+        """remove_replica's drain step. Replicas with drain support
+        get the full evacuating drain; a backend without drain() is
+        removable only once idle (polled up to `timeout` — it cannot
+        quiesce itself, so a busy one refuses removal instead of
+        cutting off its in-flight work)."""
+        src = self.replicas[replica_index]
+        if callable(getattr(src, "drain", None)):
+            return self.drain(replica_index, timeout=timeout,
+                              migrate=migrate)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while src.num_active or src.num_pending:
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.002)
+        return True
+
+    def remove_replica(self, replica_index: int, *,
+                       timeout: float | None = None,
+                       migrate: bool = True):
+        """Detach a replica AT RUNTIME (the autoscaler's scale-down
+        actuator): drain it first — with `migrate=True` (default)
+        every in-flight request is EVACUATED to a healthy replica at
+        its exact next token, zero requests lost — then tombstone its
+        index and hand the (quiesced, still-running) replica object
+        back to the caller, who owns its lifecycle from here (stop it,
+        or park it in a warm pool for a later `add_replica`).
+
+        Returns None — with the replica still attached and serving —
+        when the drain timed out; the caller retries or escalates.
+        Concurrent `submit()`s are safe throughout: during the drain
+        the replica is unready (placement skips it), and a submit that
+        captured the index before detachment fails over on the
+        tombstone's refusal."""
+        with self._lock:
+            n_attached = (len(self.replicas) - len(self._detached)
+                          - len(self._removing))
+            if (replica_index in self._detached
+                    or replica_index in self._removing
+                    or not 0 <= replica_index < len(self.replicas)):
+                raise ValueError(
+                    f"replica {replica_index} is not attached")
+            if n_attached <= 1:
+                raise ValueError(
+                    "cannot remove the last attached replica; "
+                    "stop() the router instead")
+            # claim the index: a concurrent remove_replica of the same
+            # slot (two autoscaler loops, an operator racing one) must
+            # see "not attached", not drain a replica twice
+            self._removing.add(replica_index)
+        try:
+            if not self._quiesce_for_removal(replica_index,
+                                             timeout=timeout,
+                                             migrate=migrate):
+                # timed out: the replica resumed accepting (drain's
+                # timeout contract) and STAYS attached
+                _log.warning(
+                    "remove_replica(%d): drain timed out; replica "
+                    "stays attached", replica_index)
+                return None
+            with self._lock:
+                replica = self.replicas[replica_index]
+                old_role = self.roles[replica_index]
+                self.replicas[replica_index] = _DetachedSlot()
+                self.roles[replica_index] = ROLE_COLOCATED
+                self._inflight[replica_index] = 0
+                self._breakers[replica_index] = _Breaker()
+                self._detached.add(replica_index)
+                self._accepts_hook[replica_index] = False
+                self._accepts_handoff[replica_index] = False
+                self._set_role_gauge_locked(replica_index, old_role,
+                                            None)
+                self._recompute_disagg_locked()
+        finally:
+            with self._lock:
+                self._removing.discard(replica_index)
+        _log.info("replica %d detached (was role=%s, fleet size %d)",
+                  replica_index, old_role,
+                  len(self.attached_indices()))
+        return replica
+
     def drain(self, replica_index: int, *,
               timeout: float | None = None,
               migrate: bool = True) -> bool:
@@ -1475,6 +1726,8 @@ class ReplicatedRouter:
                         return False
                     continue
                 self._m_migrations.inc()
+                # analysis: allow[lock-discipline] GIL-atomic capability
+                # slot (written once at attach under _lock), as in submit
                 hook = (self._make_fail_hook(
                             i, list(snap.prompt), dict(kw),
                             frozenset(excluded), req)
@@ -1563,8 +1816,10 @@ class ReplicatedRouter:
 
     def stop(self, drain: bool = False,
              timeout: float | None = None) -> None:
+        # analysis: allow[lock-discipline] teardown read of a
+        # GIL-atomic write-once reference (never cleared)
         if self._handoff_q is not None:
-            self._handoff_q.put(None)  # unblock the handoff worker
+            self._handoff_q.put(None)  # analysis: allow[lock-discipline] teardown, write-once reference; unblocks the handoff worker
         for i, r in enumerate(self.replicas):
             try:
                 r.stop(drain=drain, timeout=timeout)

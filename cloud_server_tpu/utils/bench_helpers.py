@@ -1,0 +1,46 @@
+"""Shared workload helpers for bench.py's serving sections.
+
+Every churn/QoS/fault/disagg section used to carry its own copy of
+the same three closures — a seeded random-prompt maker, a sorted-list
+percentile, and the keep-the-scheduler-fed top-up. Factored here so
+the sections (and the `slo_autoscale` section) agree on one
+definition; the sampling idiom (numpy RandomState, vocab [1, 30000))
+is unchanged, so existing sections measure the same token streams
+they always did.
+
+This module is bench-side tooling, not serving code: numpy is fine
+here (it is NOT on the analysis DD3/host-policy rosters, and nothing
+in the serving path imports it).
+"""
+
+from __future__ import annotations
+
+
+def make_prompt_fn(seed: int = 0, vocab: int = 30000):
+    """A seeded `mk_prompt(n) -> list[int]` closure — each bench
+    section gets its own stream (sections historically seed 0)."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+
+    def mk_prompt(n: int) -> list[int]:
+        return [int(x) for x in rng.randint(1, vocab, size=n)]
+
+    return mk_prompt
+
+
+def pct(xs, p: float) -> float:
+    """Sorted-list percentile, the bench sections' shared definition
+    (index floor, no interpolation); 0.0 on empty."""
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+
+def top_up(srv, mk_prompt, *, prompt_len: int = 64,
+           max_new_tokens: int = 256) -> None:
+    """Keep a scheduler fed: iteration-driven telemetry (the anomaly
+    watchdog, flight records) only observes BUSY iterations, so
+    measured windows need the queue to never run dry."""
+    if not (srv._jobs or srv.num_pending or srv.num_active):
+        srv.submit(mk_prompt(prompt_len), max_new_tokens=max_new_tokens)

@@ -60,7 +60,14 @@
 # [a-f], as does tests/test_anomaly.py (anomaly watchdog + tail-based
 # trace retention + forensic bundles: rule hysteresis with injected
 # clocks, the retention predicate clause by clause, fleet stat
-# merging, bundle auto-capture, /debug/bundle). The suite is also
+# merging, bundle auto-capture, /debug/bundle), and
+# tests/test_scenarios.py (scenario harness + SLO-burn autoscaler:
+# seeded workload determinism, the replay timing contract, the
+# discrete-event simulator's calibration-vs-live bar, autoscaler
+# decision law with stub fleets, the scale-down drain race, and the
+# replay-driven dispatch-count clone) rides [s-z] — its two heavies
+# (calibration, dispatch clone) share the group process's jit cache
+# with the other serving e2es. The suite is also
 # runnable
 # standalone:
 #   python -m cloud_server_tpu.analysis [--json] [--checker <id>]
@@ -135,6 +142,31 @@
 # decode on a loaded box — fixed by enlarging the decode window to
 # 32 tokens (the flood-test fix), not by demotion. DOTS lands at 556
 # vs the 547 baseline.
+# PR 20 re-balance: tests/test_scenarios.py's ~58 s tier-1 set (its
+# two heavies — the sim calibration-vs-live run and the replay-driven
+# dispatch-count clone — compile fresh bucket shapes) measured a
+# COMPLETE green run at 1034 s / 616 dots on a ~20%-slow load window
+# (1.68 s/item vs the 1.4 typical; the PR-15 caveat window) — the
+# timed gate truncated. Nine redundant heavies (~98 s at that speed,
+# the PR-20 block at the end of tests/slow_tests.txt): the span-tree
+# preemption soak (span recording keeps broad fast coverage and the
+# preempt-requeue lifecycle twin was already slow); the profiler
+# dispatch/sync/clock-count clone (the canonical test_observability
+# guard plus the anomaly_tail and new scenario-replay clones stay
+# fast); the migration snapshot-field/evacuation audit and the
+# drain(migrate=True) evacuate-all e2e (the new
+# scale-down-drain-race and add/remove-replica live tests keep fast
+# drain-migrate coverage; the chaos kill and live-migration exactness
+# e2es stay fast); many-adapters-matches-merged (the single-adapter
+# parity twin stays); the contiguous server engine-parity (its
+# CLI contiguous-vs-paged twin stays); grammar pattern[2] (the [0]
+# twin stays; spec-grammar parity was already slow); the heaviest
+# xla-reference-matches-dense shape (three cheaper shapes stay); and
+# the logit-bias HTTP [paged-spec] variant (the [paged] twin stays).
+# Per the PR-15 precedent this targets the TYPICAL box speed
+# (~780 s complete, ~90 s headroom); a sustained slow window can
+# still truncate with zero failures in the executed prefix — the
+# full set was verified green in a complete untimed run.
 MARK=(-m "not slow")
 if [ "$1" = "--all" ]; then
     MARK=(); shift

@@ -1211,3 +1211,51 @@ def test_dispatch_nonliteral_static_argnames_is_a_finding():
                                                ("S.loop",), ())
     assert any("not a literal" in f.message for f in findings), \
         [str(f) for f in findings]
+
+
+def test_checker_flags_bad_scenario_paths():
+    """Fixture round-trip proving the checker is LIVE on the scenario
+    harness's violation shapes: a tick that reads the wall clock, a
+    tick that sleeps until the next event, firing lag through a numpy
+    buffer, logging every rejection from the firing path, printing
+    the autoscaler decision — each must fire; the plain list/float
+    event-pop shape the real tick() uses must not."""
+    src = (_FIXTURES / "hot_path_scenarios_bad.py").read_text()
+    cases = {
+        "BadDriver.tick_reads_clock": "time.time",
+        "BadDriver.tick_sleeps": "sleep",
+        "BadDriver.fire_numpy_lag": "numpy",
+        "BadDriver.fire_logged": "logging",
+        "BadDriver.evaluate_prints": "I/O",
+    }
+    for qual, needle in cases.items():
+        findings = check_source("hot_path_scenarios_bad.py", src,
+                                (qual,))
+        assert findings, f"{qual}: expected a finding"
+        assert any(needle in f.message for f in findings), \
+            f"{qual}: {[str(f) for f in findings]}"
+    assert not check_source("hot_path_scenarios_bad.py", src,
+                            ("BadDriver.tick_fine",))
+
+
+def test_registry_covers_scenarios():
+    """The scenario harness rides both static passes: the replay
+    driver's firing path and the autoscaler's decision path are
+    hot-path rostered, and all four scenarios modules are DD3
+    host-policy (the simulator MODELS device iterations from fitted
+    flight-record costs — it must never run one)."""
+    replay = "cloud_server_tpu/scenarios/replay.py"
+    asc = "cloud_server_tpu/scenarios/autoscaler.py"
+    for needed in ("ReplayDriver.tick", "ReplayDriver._fire"):
+        assert needed in HOT_PATHS[replay], \
+            f"{needed} dropped from HOT_PATHS"
+    for needed in ("SLOBurnAutoscaler.evaluate",
+                   "SLOBurnAutoscaler._burn_signal"):
+        assert needed in HOT_PATHS[asc], \
+            f"{needed} dropped from HOT_PATHS"
+    for rel in ("cloud_server_tpu/scenarios/workload.py",
+                replay,
+                "cloud_server_tpu/scenarios/simulator.py",
+                asc):
+        assert rel in dispatch.HOST_POLICY_MODULES, \
+            f"{rel} dropped from the DD3 host-policy roster"

@@ -620,9 +620,17 @@ def test_metric_catalog_matches_docs(params):
     # retry/breaker counters + breaker-state gauges) register too
     from cloud_server_tpu.inference.router import ReplicatedRouter
     router = ReplicatedRouter([paged])
+    # an autoscaler over the router (its cloud_server_autoscaler_*
+    # families register eagerly into the router registry) and a replay
+    # driver (cloud_server_scenario_*) — the scenario-harness families
+    # are part of the catalog contract too
+    from cloud_server_tpu.scenarios import ReplayDriver, SLOBurnAutoscaler
+    SLOBurnAutoscaler(router, spawn=lambda role: None)
+    driver = ReplayDriver(router, [])
     runtime = {name.split("{")[0] for name in
                set(contig.metrics_snapshot())
-               | set(router.metrics_snapshot())}
+               | set(router.metrics_snapshot())
+               | set(driver.metrics_snapshot())}
     missing_from_docs = runtime - catalog
     stale_in_docs = catalog - runtime
     assert not missing_from_docs, (

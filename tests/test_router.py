@@ -403,3 +403,141 @@ def test_burst_submit_sees_inflight_picks():
     t.join(10)
     assert a.got == [[1]]
     assert router._inflight == [0, 0]  # settled after both complete
+
+
+# ---------------------------------------------------------------------------
+# runtime fleet mutation (the autoscaler's actuation surface)
+# ---------------------------------------------------------------------------
+
+
+def test_add_remove_replica_live(params):
+    """add_replica() grows a serving fleet in place; remove_replica
+    (migrate=True) evacuates the victim's in-flight work and returns
+    the quiesced replica. Indices are TOMBSTONED, never shifted, and
+    a later add_replica reuses the detached slot."""
+    mk = lambda: PagedInferenceServer(params, CFG, GREEDY,  # noqa: E731
+                                      **SRV_KW)
+    router = ReplicatedRouter([mk()])
+    assert router.attached_indices() == [0]
+    i = router.add_replica(mk())
+    assert i == 1 and router.attached_indices() == [0, 1]
+    reqs = [router.submit(PROMPT, max_new_tokens=6) for _ in range(6)]
+    router.step()
+    assert all(r.num_active + r.num_pending > 0 for r in router.replicas)
+    import threading
+    import time as _time
+    stepper = threading.Thread(
+        target=lambda: [router.step() or _time.sleep(0.002)
+                        for _ in range(3000)], daemon=True)
+    stepper.start()
+    gone = router.remove_replica(0, migrate=True, timeout=60.0)
+    assert gone is not None and gone.num_active == 0
+    assert router.attached_indices() == [1]
+    assert 0 not in router.breaker_states()
+    deadline = _time.monotonic() + 60.0
+    while (not all(r.done for r in reqs)
+           and _time.monotonic() < deadline):
+        _time.sleep(0.01)
+    assert all(len(r.tokens) == 6 for r in reqs), (
+        [(len(r.tokens), r.finish_reason) for r in reqs])
+    # a racing submit that captured the dead index is refused by the
+    # tombstone, not misrouted
+    with pytest.raises(RuntimeError):
+        router.replicas[0].submit(PROMPT)
+    # new traffic still flows, and re-adding reuses the detached slot
+    after = router.submit(PROMPT, max_new_tokens=4)
+    assert router.add_replica(mk()) == 0
+    assert router.attached_indices() == [0, 1]
+    router.run_until_idle()
+    assert len(after.tokens) == 4
+    gone.stop()
+    router.stop()
+
+
+def test_remove_replica_validation(params):
+    srv = PagedInferenceServer(params, CFG, GREEDY, **SRV_KW)
+    router = ReplicatedRouter([srv])
+    with pytest.raises(ValueError):
+        router.remove_replica(0)      # never strand the fleet at zero
+    with pytest.raises(ValueError):
+        router.remove_replica(7)
+    with pytest.raises(ValueError):
+        router.add_replica(object(), role="chaos")
+    router.stop()
+
+
+def test_remove_replica_racing_concurrent_submits():
+    """Submitter threads hammer the router while a replica is removed
+    mid-run: racing submits that captured the victim's index hit the
+    detached tombstone and FAIL OVER — the client sees zero refusals
+    and every request lands exactly once."""
+    import threading
+    import time as _time
+
+    class _RemovableStub:
+        def __init__(self):
+            self._draining = False
+            self.got = []
+            self._lock = threading.Lock()
+            self.num_active = 0
+
+        @property
+        def ready(self):
+            return not self._draining
+
+        @property
+        def num_pending(self):
+            return 0
+
+        def submit(self, prompt, **kw):
+            with self._lock:
+                if self._draining:
+                    raise RuntimeError("server is draining")
+                self.got.append(prompt)
+            return prompt
+
+        def drain(self, *a, **kw):
+            with self._lock:
+                self._draining = True
+            return True
+
+        def resume(self):
+            with self._lock:
+                self._draining = False
+
+        def stop(self):
+            pass
+
+    victim, survivor = _RemovableStub(), _RemovableStub()
+    router = ReplicatedRouter([victim, survivor])
+    errors = []
+    removed = threading.Event()
+
+    def submitter(base):
+        try:
+            for k in range(80):
+                router.submit([base + k])
+                if k == 20 and base == 0:
+                    removed.set()
+        except Exception as exc:  # noqa: BLE001 — the assertion
+            errors.append(exc)
+
+    def remover():
+        assert removed.wait(30)
+        got = router.remove_replica(0, migrate=True, timeout=10.0)
+        assert got is victim
+
+    subs = [threading.Thread(target=submitter, args=(1000 * i,))
+            for i in range(4)]
+    rem = threading.Thread(target=remover)
+    for t in subs + [rem]:
+        t.start()
+    for t in subs + [rem]:
+        t.join(30)
+    assert not errors, f"submits refused through removal: {errors!r}"
+    landed = victim.got + survivor.got
+    assert len(landed) == 320
+    assert len({tuple(p) for p in landed}) == 320  # exactly-once
+    assert router.attached_indices() == [1]
+    # the tail of the run was served by the survivor alone
+    assert survivor.got
